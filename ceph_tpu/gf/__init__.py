@@ -1,0 +1,32 @@
+"""GF(2^8) arithmetic core for erasure coding.
+
+Field semantics follow Intel ISA-L / jerasure's default w=8 field: the
+primitive polynomial is x^8 + x^4 + x^3 + x^2 + 1 (0x11d), so our parity
+bytes are bit-identical to what the reference's ISA plugin produces
+(reference: src/erasure-code/isa/ErasureCodeIsa.cc:380-421 builds its
+coefficients with gf_gen_rs_matrix / gf_gen_cauchy1_matrix over this field).
+"""
+
+from .gf8 import (  # noqa: F401
+    GF_POLY,
+    GF_EXP,
+    GF_LOG,
+    GF_INV,
+    gf_mul,
+    gf_div,
+    gf_inv,
+    gf_pow,
+    gf_mul_bytes,
+    gf_matmul,
+    gf_invert_matrix,
+    gf_mul_bitmatrix,
+    coeff_to_bitmatrix,
+    matrix_to_bitmatrix,
+)
+from .matrices import (  # noqa: F401
+    gen_rs_matrix,
+    gen_cauchy1_matrix,
+    gen_jerasure_rs_vandermonde,
+    build_decode_matrix,
+    erasure_signature,
+)

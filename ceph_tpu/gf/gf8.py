@@ -1,0 +1,186 @@
+"""GF(2^8) table-based arithmetic (numpy, host side).
+
+This is the scalar/host reference implementation; the TPU path in
+``ceph_tpu.ops`` reformulates the same field operations as GF(2) bit-matrix
+multiplications that run on the MXU.  Both must agree byte-for-byte.
+
+The field is GF(2)[x] / (x^8 + x^4 + x^3 + x^2 + 1)  (poly 0x11d), the field
+used by ISA-L erasure coding and jerasure w=8, which is what the reference's
+ISA plugin drives (reference: src/erasure-code/isa/ErasureCodeIsa.cc:27,
+via the isa-l submodule's ec_encode_data / gf_mul).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+GF_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+_GEN = 2  # x is a generator for 0x11d
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    v = 1
+    for i in range(255):
+        exp[i] = v
+        log[v] = i
+        v <<= 1
+        if v & 0x100:
+            v ^= GF_POLY
+    # replicate so exp[log a + log b] never needs a mod
+    for i in range(255, 512):
+        exp[i] = exp[i - 255]
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+# full 256x256 multiplication table: GF_MUL_TABLE[a, b] = a*b
+_la = GF_LOG[:, None] + GF_LOG[None, :]
+GF_MUL_TABLE = GF_EXP[_la]
+GF_MUL_TABLE[0, :] = 0
+GF_MUL_TABLE[:, 0] = 0
+del _la
+
+GF_INV = np.zeros(256, dtype=np.uint8)
+GF_INV[1:] = GF_EXP[255 - GF_LOG[1:]]
+
+
+def gf_mul(a: int, b: int) -> int:
+    """Scalar product in GF(2^8)."""
+    return int(GF_MUL_TABLE[a & 0xFF, b & 0xFF])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    if a == 0:
+        return 0
+    return int(GF_EXP[(GF_LOG[a] - GF_LOG[b]) % 255])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(2^8) inverse of zero")
+    return int(GF_INV[a])
+
+
+def gf_pow(a: int, n: int) -> int:
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(GF_EXP[(GF_LOG[a] * n) % 255])
+
+
+def gf_mul_bytes(c: int, data: np.ndarray) -> np.ndarray:
+    """Multiply every byte of ``data`` by the constant ``c``."""
+    data = np.asarray(data, dtype=np.uint8)
+    return GF_MUL_TABLE[c][data]
+
+
+def gf_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """GF(2^8) matrix product of an (r,k) coefficient matrix with (k,n) bytes.
+
+    out[i, :] = XOR_j  mat[i, j] * data[j, :]
+
+    This is exactly what ISA-L's ec_encode_data computes with its expanded
+    tables (the hot loop the TPU kernels replace).
+    """
+    mat = np.asarray(mat, dtype=np.uint8)
+    data = np.asarray(data, dtype=np.uint8)
+    r, k = mat.shape
+    assert data.shape[0] == k, (mat.shape, data.shape)
+    out = np.zeros((r, data.shape[1]), dtype=np.uint8)
+    for i in range(r):
+        acc = out[i]
+        for j in range(k):
+            c = mat[i, j]
+            if c == 0:
+                continue
+            elif c == 1:
+                acc ^= data[j]
+            else:
+                acc ^= GF_MUL_TABLE[c][data[j]]
+        out[i] = acc
+    return out
+
+
+def gf_invert_matrix(mat: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2^8) matrix by Gauss-Jordan with partial pivoting.
+
+    Raises ValueError when singular (mirrors gf_invert_matrix < 0 in the
+    reference's decode path, src/erasure-code/isa/ErasureCodeIsa.cc:292).
+    """
+    mat = np.array(mat, dtype=np.uint8, copy=True)
+    n = mat.shape[0]
+    assert mat.shape == (n, n)
+    aug = np.concatenate([mat, np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = -1
+        for row in range(col, n):
+            if aug[row, col]:
+                pivot = row
+                break
+        if pivot < 0:
+            raise ValueError("singular GF(2^8) matrix")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        inv = GF_INV[aug[col, col]]
+        aug[col] = GF_MUL_TABLE[inv][aug[col]]
+        for row in range(n):
+            if row != col and aug[row, col]:
+                aug[row] ^= GF_MUL_TABLE[aug[row, col]][aug[col]]
+    return aug[:, n:].copy()
+
+
+# ---------------------------------------------------------------------------
+# GF(2) bit-matrix representation.
+#
+# Multiplication by a constant c is linear over GF(2): representing a byte as
+# its 8 polynomial coefficient bits (bit i = coefficient of x^i), there is an
+# 8x8 binary matrix M_c with  bits(c*d) = M_c @ bits(d) (mod 2).  A full
+# (m,k) GF coefficient matrix becomes an (8m, 8k) binary matrix, turning RS
+# encode into a plain binary matmul -- the formulation the TPU MXU runs.
+# ---------------------------------------------------------------------------
+
+def coeff_to_bitmatrix(c: int) -> np.ndarray:
+    """8x8 GF(2) matrix of multiplication by constant ``c``.
+
+    Column t holds the bits of c * x^t.
+    """
+    out = np.zeros((8, 8), dtype=np.uint8)
+    for t in range(8):
+        prod = gf_mul(c, 1 << t)
+        for i in range(8):
+            out[i, t] = (prod >> i) & 1
+    return out
+
+
+def matrix_to_bitmatrix(mat: np.ndarray) -> np.ndarray:
+    """Expand an (r,k) GF(2^8) coefficient matrix to its (8r,8k) GF(2) form."""
+    mat = np.asarray(mat, dtype=np.uint8)
+    r, k = mat.shape
+    out = np.zeros((8 * r, 8 * k), dtype=np.uint8)
+    for i in range(r):
+        for j in range(k):
+            out[8 * i:8 * i + 8, 8 * j:8 * j + 8] = coeff_to_bitmatrix(mat[i, j])
+    return out
+
+
+def gf_mul_bitmatrix(bitmat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Reference bit-matmul evaluation: (8r,8k) x (k,n) bytes -> (r,n) bytes.
+
+    Slow (numpy) -- used only to validate the TPU kernels' formulation.
+    """
+    data = np.asarray(data, dtype=np.uint8)
+    k = data.shape[0]
+    n = data.shape[1]
+    r8 = bitmat.shape[0]
+    assert bitmat.shape[1] == 8 * k
+    shifts = np.arange(8, dtype=np.uint8)
+    bits = ((data[:, None, :] >> shifts[None, :, None]) & 1).reshape(8 * k, n)
+    out_bits = (bitmat.astype(np.int32) @ bits.astype(np.int32)) & 1
+    out_bits = out_bits.reshape(r8 // 8, 8, n).astype(np.uint8)
+    return (out_bits << shifts[None, :, None]).sum(axis=1).astype(np.uint8)

@@ -1,0 +1,15 @@
+"""Test harness config: force an 8-device virtual CPU mesh for JAX tests.
+
+Multi-chip TPU hardware is not available in CI; sharding correctness is
+validated on a host-platform device mesh exactly as the driver's
+dryrun_multichip does.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,159 @@
+import numpy as np
+import pytest
+
+from ceph_tpu.gf import (
+    GF_EXP,
+    GF_LOG,
+    gf_mul,
+    gf_div,
+    gf_inv,
+    gf_pow,
+    gf_matmul,
+    gf_invert_matrix,
+    gf_mul_bitmatrix,
+    matrix_to_bitmatrix,
+    gen_rs_matrix,
+    gen_cauchy1_matrix,
+    gen_jerasure_rs_vandermonde,
+    build_decode_matrix,
+)
+
+
+def slow_mul(a, b):
+    """Bitwise carry-less multiply + reduction by 0x11d, independent oracle."""
+    r = 0
+    for i in range(8):
+        if (b >> i) & 1:
+            r ^= a << i
+    for i in range(15, 7, -1):
+        if (r >> i) & 1:
+            r ^= 0x11D << (i - 8)
+    return r
+
+
+def test_tables_match_slow_mul():
+    rng = np.random.default_rng(0)
+    for _ in range(2000):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        assert gf_mul(a, b) == slow_mul(a, b)
+
+
+def test_field_axioms():
+    for a in range(1, 256):
+        assert gf_mul(a, gf_inv(a)) == 1
+        assert gf_div(a, a) == 1
+        assert gf_mul(a, 1) == a
+        assert gf_mul(a, 0) == 0
+    # associativity / distributivity spot checks
+    rng = np.random.default_rng(1)
+    for _ in range(500):
+        a, b, c = (int(x) for x in rng.integers(256, size=3))
+        assert gf_mul(a, gf_mul(b, c)) == gf_mul(gf_mul(a, b), c)
+        assert gf_mul(a, b ^ c) == gf_mul(a, b) ^ gf_mul(a, c)
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert GF_EXP[GF_LOG[a]] == a
+
+
+def test_gf_pow():
+    assert gf_pow(2, 0) == 1
+    assert gf_pow(2, 1) == 2
+    assert gf_pow(0, 5) == 0
+    for n in range(1, 300):
+        assert gf_pow(3, n) == gf_mul(gf_pow(3, n - 1), 3)
+
+
+def test_matrix_inverse():
+    rng = np.random.default_rng(2)
+    for _ in range(20):
+        k = int(rng.integers(2, 12))
+        while True:
+            m = rng.integers(0, 256, size=(k, k)).astype(np.uint8)
+            try:
+                inv = gf_invert_matrix(m)
+                break
+            except ValueError:
+                continue
+        prod = gf_matmul(m, inv)
+        assert np.array_equal(prod, np.eye(k, dtype=np.uint8))
+
+
+def test_singular_matrix_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf_invert_matrix(m)
+
+
+def test_rs_matrix_structure():
+    k, m = 8, 3
+    a = gen_rs_matrix(k + m, k)
+    assert np.array_equal(a[:k], np.eye(k, dtype=np.uint8))
+    # parity row r = [(2^r)^j]
+    for r in range(m):
+        g = gf_pow(2, r)
+        for j in range(k):
+            assert a[k + r, j] == gf_pow(g, j)
+    # first parity row is all ones (g=1)
+    assert (a[k] == 1).all()
+
+
+def test_cauchy_matrix_structure():
+    k, m = 10, 4
+    a = gen_cauchy1_matrix(k + m, k)
+    assert np.array_equal(a[:k], np.eye(k, dtype=np.uint8))
+    for i in range(k, k + m):
+        for j in range(k):
+            assert a[i, j] == gf_inv(i ^ j)
+    # every kxk submatrix of a Cauchy-extended generator is invertible:
+    # losing any m shards must be recoverable
+    import itertools
+    for lost in itertools.combinations(range(k + m), m):
+        survivors = [i for i in range(k + m) if i not in lost][:k]
+        gf_invert_matrix(a[survivors][:, :k])
+
+
+def test_jerasure_vandermonde_row0_ones():
+    for k, m in [(2, 1), (4, 2), (8, 3), (10, 4)]:
+        c = gen_jerasure_rs_vandermonde(k, m)
+        assert c.shape == (m, k)
+        assert (c[0] == 1).all(), (k, m, c)
+
+
+def test_jerasure_vandermonde_mds():
+    import itertools
+    k, m = 6, 3
+    c = gen_jerasure_rs_vandermonde(k, m)
+    gen = np.concatenate([np.eye(k, dtype=np.uint8), c], axis=0)
+    for lost in itertools.combinations(range(k + m), m):
+        survivors = [i for i in range(k + m) if i not in lost][:k]
+        gf_invert_matrix(gen[survivors])
+
+
+def test_bitmatrix_equals_bytematrix():
+    rng = np.random.default_rng(3)
+    k, m = 8, 3
+    a = gen_rs_matrix(k + m, k)
+    parity_rows = a[k:]
+    data = rng.integers(0, 256, size=(k, 257)).astype(np.uint8)
+    want = gf_matmul(parity_rows, data)
+    bitmat = matrix_to_bitmatrix(parity_rows)
+    got = gf_mul_bitmatrix(bitmat, data)
+    assert np.array_equal(want, got)
+
+
+def test_decode_matrix_recovers():
+    rng = np.random.default_rng(4)
+    for k, m in [(8, 3), (10, 4), (4, 2)]:
+        gen = gen_rs_matrix(k + m, k) if m <= 4 else gen_cauchy1_matrix(k + m, k)
+        data = rng.integers(0, 256, size=(k, 64)).astype(np.uint8)
+        parity = gf_matmul(gen[k:], data)
+        full = np.concatenate([data, parity], axis=0)
+        # erase up to m shards (vandermonde: stick to patterns incl. parity)
+        for erasures in ([0], [k], [0, 1], [0, k + 1], [1, k - 1]):
+            erasures = [e for e in erasures if e < k + m][:m]
+            dec, idx = build_decode_matrix(gen, k, erasures)
+            recovered = gf_matmul(dec, full[idx])
+            for p, e in enumerate(erasures):
+                assert np.array_equal(recovered[p], full[e]), (k, m, erasures)

@@ -1,0 +1,11 @@
+"""Erasure-code layer: interface, base class, plugin registry, plugins.
+
+Mirrors the reference's plugin architecture (src/erasure-code/
+ErasureCodeInterface.h:170, ErasureCodePlugin.cc:86) so that the benchmark
+harness and the OSD ECBackend select codecs purely by profile name, while the
+actual math runs as TPU kernels (ceph_tpu.ops).
+"""
+
+from .interface import ErasureCodeInterface, ErasureCodeProfile  # noqa: F401
+from .base import ErasureCode, SIMD_ALIGN  # noqa: F401
+from .registry import ErasureCodePluginRegistry, instance as registry  # noqa: F401

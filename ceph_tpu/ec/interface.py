@@ -1,0 +1,106 @@
+"""Abstract erasure-code codec contract.
+
+Python rendering of the reference's ErasureCodeInterface
+(src/erasure-code/ErasureCodeInterface.h:170-470): systematic codes split an
+object into k data chunks + m coding chunks; chunk i of a stripe lives on
+shard i; array codes may subdivide chunks into sub-chunks.  Buffers are
+``bytes``/``numpy.uint8`` arrays rather than bufferlists; chunk maps are
+``dict[int, np.ndarray]``.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Mapping
+
+import numpy as np
+
+# profile: str -> str mapping, same shape as ErasureCodeProfile
+ErasureCodeProfile = dict
+
+
+class ErasureCodeInterface(ABC):
+    """Codec contract.  All chunk indices are *shard* ids in [0, k+m)."""
+
+    @abstractmethod
+    def init(self, profile: ErasureCodeProfile) -> None:
+        """Initialize from a profile; raises ValueError on bad profiles.
+
+        Implementations must record the profile so get_profile() echoes it
+        (the registry verifies the echo, as ErasureCodePlugin.cc:99 does).
+        """
+
+    @abstractmethod
+    def get_profile(self) -> ErasureCodeProfile:
+        ...
+
+    @abstractmethod
+    def get_chunk_count(self) -> int:
+        """k + m."""
+
+    @abstractmethod
+    def get_data_chunk_count(self) -> int:
+        """k."""
+
+    def get_coding_chunk_count(self) -> int:
+        return self.get_chunk_count() - self.get_data_chunk_count()
+
+    def get_sub_chunk_count(self) -> int:
+        """Array codes (Clay) override; 1 otherwise."""
+        return 1
+
+    @abstractmethod
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """Chunk size for an object of ``stripe_width`` bytes (incl. padding)."""
+
+    @abstractmethod
+    def minimum_to_decode(
+        self, want_to_read: set[int], available: set[int],
+    ) -> dict[int, list[tuple[int, int]]]:
+        """Chunks (and sub-chunk ranges) to retrieve to read want_to_read.
+
+        Returns {shard: [(offset, count), ...]} in sub-chunk units.
+        Raises IOError if decoding is impossible.
+        """
+
+    @abstractmethod
+    def minimum_to_decode_with_cost(
+        self, want_to_read: set[int], available: Mapping[int, int],
+    ) -> set[int]:
+        """Like minimum_to_decode but given per-chunk retrieval costs."""
+
+    @abstractmethod
+    def encode(
+        self, want_to_encode: set[int], data: bytes,
+    ) -> dict[int, np.ndarray]:
+        """Split+pad ``data`` into k chunks, compute m parity chunks, return
+        the requested subset."""
+
+    @abstractmethod
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        """Compute parity in place over prepared, equal-size chunks."""
+
+    @abstractmethod
+    def decode(
+        self, want_to_read: set[int], chunks: Mapping[int, np.ndarray],
+        chunk_size: int = 0,
+    ) -> dict[int, np.ndarray]:
+        """Reconstruct the requested chunks from the available ones."""
+
+    @abstractmethod
+    def decode_chunks(
+        self, want_to_read: set[int], chunks: Mapping[int, np.ndarray],
+        decoded: dict[int, np.ndarray],
+    ) -> None:
+        ...
+
+    @abstractmethod
+    def get_chunk_mapping(self) -> list[int]:
+        """Pseudo-layout remap (LRC "mapping" profiles); [] = identity."""
+
+    def decode_concat(self, chunks: Mapping[int, np.ndarray]) -> bytes:
+        """Reconstruct and concatenate the data chunks in order."""
+        k = self.get_data_chunk_count()
+        want = set(range(k))
+        decoded = self.decode(want, chunks)
+        return b"".join(bytes(decoded[i]) for i in range(k))

@@ -1,0 +1,1 @@
+"""Builtin erasure-code plugins (module per plugin, import = dlopen)."""

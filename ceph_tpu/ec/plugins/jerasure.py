@@ -1,0 +1,132 @@
+"""jerasure-semantics Reed-Solomon plugin (w=8 techniques).
+
+Mirrors the reference's jerasure plugin techniques that operate byte-wise in
+GF(2^8) (src/erasure-code/jerasure/ErasureCodeJerasure.cc):
+
+  * reed_sol_van  -- systematized extended-Vandermonde matrix
+    (reed_sol_vandermonde_coding_matrix, ErasureCodeJerasure.cc:203)
+  * reed_sol_r6_op -- RAID6 rows [1,1,..], [1,2,4,..] with m forced to 2
+
+Bit-matrix techniques (cauchy_orig/cauchy_good/liberation/blaum_roth/
+liber8tion) pack w sub-packets per element and are scheduled for a later
+round.  Chunk sizing follows ErasureCodeJerasure::get_chunk_size
+(:80-104): stripe padded to a multiple of k*w*sizeof(int) then divided.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..rs_codec import RSMatrixCodec
+from ..registry import ErasureCodePlugin
+from ...gf import gen_jerasure_rs_vandermonde, gf_pow
+
+LARGEST_VECTOR_WORDSIZE = 16
+
+DEFAULT_K = "2"
+DEFAULT_M = "1"
+DEFAULT_W = "8"
+
+
+class ErasureCodeJerasure(RSMatrixCodec):
+    technique = "reed_sol_van"
+    DEFAULT_K = DEFAULT_K
+    DEFAULT_M = DEFAULT_M
+
+    def __init__(self, backend=None) -> None:
+        super().__init__(backend=backend)
+        self.w = 8
+        self.per_chunk_alignment = False
+
+    def get_alignment(self) -> int:
+        if self.per_chunk_alignment:
+            return self.w * LARGEST_VECTOR_WORDSIZE
+        alignment = self.k * self.w * 4  # sizeof(int)
+        if (self.w * 4) % LARGEST_VECTOR_WORDSIZE:
+            alignment = self.k * self.w * LARGEST_VECTOR_WORDSIZE
+        return alignment
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        alignment = self.get_alignment()
+        if self.per_chunk_alignment:
+            chunk_size = (stripe_width + self.k - 1) // self.k
+            modulo = chunk_size % alignment
+            if modulo:
+                chunk_size += alignment - modulo
+            return chunk_size
+        tail = stripe_width % alignment
+        padded = stripe_width + (alignment - tail if tail else 0)
+        assert padded % self.k == 0
+        return padded // self.k
+
+    def parse_base(self, profile) -> None:
+        self.k = self.to_int("k", profile, self.DEFAULT_K)
+        self.m = self.to_int("m", profile, self.DEFAULT_M)
+        self.w = self.to_int("w", profile, DEFAULT_W)
+        self.sanity_check_k_m(self.k, self.m)
+        if self.w not in (8, 16, 32):
+            # reference resets to default with a notice (:154-160)
+            self.w = 8
+        if self.w != 8:
+            raise NotImplementedError(
+                "jerasure w=16/32 (GF(2^16)/GF(2^32) words) not yet built")
+        self.per_chunk_alignment = (
+            str(profile.get("jerasure-per-chunk-alignment", "false")).lower()
+            in ("true", "1", "yes"))
+
+    def init(self, profile) -> None:
+        self.parse(profile)
+        self.parse_base(profile)
+        self.prepare()
+        super().init(profile)
+
+
+class ErasureCodeJerasureReedSolomonVandermonde(ErasureCodeJerasure):
+    technique = "reed_sol_van"
+    DEFAULT_K = "7"
+    DEFAULT_M = "3"
+
+    def prepare(self) -> None:
+        coding = gen_jerasure_rs_vandermonde(self.k, self.m)
+        self.encode_matrix = np.concatenate(
+            [np.eye(self.k, dtype=np.uint8), coding], axis=0)
+
+
+class ErasureCodeJerasureReedSolomonRAID6(ErasureCodeJerasure):
+    technique = "reed_sol_r6_op"
+    DEFAULT_K = "7"
+    DEFAULT_M = "2"
+
+    def parse_base(self, profile) -> None:
+        super().parse_base(profile)
+        # RAID6 technique pins m=2 (ErasureCodeJerasure.h:111-128)
+        self.m = 2
+
+    def prepare(self) -> None:
+        k = self.k
+        coding = np.zeros((2, k), dtype=np.uint8)
+        coding[0, :] = 1
+        for j in range(k):
+            coding[1, j] = gf_pow(2, j)
+        self.encode_matrix = np.concatenate(
+            [np.eye(k, dtype=np.uint8), coding], axis=0)
+
+
+TECHNIQUES = {
+    "reed_sol_van": ErasureCodeJerasureReedSolomonVandermonde,
+    "reed_sol_r6_op": ErasureCodeJerasureReedSolomonRAID6,
+}
+
+
+def _factory(profile):
+    technique = profile.get("technique", "reed_sol_van")
+    cls = TECHNIQUES.get(technique)
+    if cls is None:
+        raise ValueError(
+            f"jerasure: technique {technique} not supported "
+            f"(have {sorted(TECHNIQUES)})")
+    return cls()
+
+
+def __erasure_code_init__(registry, name: str) -> None:
+    registry.add(name, ErasureCodePlugin(_factory))

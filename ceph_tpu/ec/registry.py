@@ -1,0 +1,146 @@
+"""Erasure-code plugin registry.
+
+Python rendering of ErasureCodePluginRegistry (src/erasure-code/
+ErasureCodePlugin.cc): plugins are named factories resolved at first use;
+loading is by module import (the dlopen analog) from the builtin plugin
+package or an explicit plugin directory; a version handshake and the
+profile-echo check (:99-113) are preserved.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import sys
+import threading
+from pathlib import Path
+from typing import Callable
+
+from .interface import ErasureCodeInterface, ErasureCodeProfile
+
+# version handshake analog of PLUGIN_VERSION vs CEPH_GIT_NICE_VER
+PLUGIN_API_VERSION = 1
+
+# module attribute every plugin module must expose (entry-point analog of
+# __erasure_code_init, ErasureCodePlugin.h:24-27)
+ENTRY_POINT = "__erasure_code_init__"
+
+DEFAULT_PLUGIN_PACKAGE = "ceph_tpu.ec.plugins"
+
+
+class ErasureCodePlugin:
+    """A named factory.  Subclass or instantiate with a factory callable."""
+
+    def __init__(self, factory: Callable[[ErasureCodeProfile],
+                                         ErasureCodeInterface],
+                 api_version: int = PLUGIN_API_VERSION) -> None:
+        self.api_version = api_version
+        self._factory = factory
+
+    def factory(self, profile: ErasureCodeProfile) -> ErasureCodeInterface:
+        codec = self._factory(profile)
+        codec.init(profile)
+        return codec
+
+
+class ErasureCodePluginRegistry:
+    def __init__(self) -> None:
+        # reentrant: load() holds it while the plugin entry point calls add()
+        self._lock = threading.RLock()
+        self._plugins: dict[str, ErasureCodePlugin] = {}
+        self.disable_dlclose = False  # parity knob; unused
+
+    def add(self, name: str, plugin: ErasureCodePlugin) -> None:
+        with self._lock:
+            if name in self._plugins:
+                raise ValueError(f"plugin {name} already registered")
+            self._plugins[name] = plugin
+
+    def get(self, name: str) -> ErasureCodePlugin | None:
+        return self._plugins.get(name)
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._plugins.pop(name, None)
+
+    def load(self, plugin_name: str, directory: str | None = None) -> ErasureCodePlugin:
+        """Import the plugin module and run its entry point.
+
+        A module is looked up as ``<directory>/ec_<name>.py`` when a
+        directory is given (the libec_<name>.so analog), else as
+        ``ceph_tpu.ec.plugins.<name>``.
+        """
+        with self._lock:
+            if plugin_name in self._plugins:
+                return self._plugins[plugin_name]
+            if directory:
+                path = Path(directory) / f"ec_{plugin_name}.py"
+                if not path.exists():
+                    raise FileNotFoundError(
+                        f"load dlopen({path}): file not found")
+                spec = importlib.util.spec_from_file_location(
+                    f"ceph_tpu_ec_plugin_{plugin_name}", path)
+                module = importlib.util.module_from_spec(spec)
+                sys.modules[spec.name] = module
+                spec.loader.exec_module(module)
+            else:
+                try:
+                    module = importlib.import_module(
+                        f"{DEFAULT_PLUGIN_PACKAGE}.{plugin_name}")
+                except ImportError as e:
+                    raise FileNotFoundError(
+                        f"load dlopen(ec_{plugin_name}): {e}") from e
+            entry = getattr(module, ENTRY_POINT, None)
+            if entry is None:
+                raise ImportError(
+                    f"erasure-code plugin {plugin_name}: missing entry point "
+                    f"{ENTRY_POINT}")
+            # the entry point registers itself (possibly under several names)
+            entry(self, plugin_name)
+            plugin = self._plugins.get(plugin_name)
+            if plugin is None:
+                raise ImportError(
+                    f"erasure-code plugin {plugin_name}: entry point did not "
+                    f"register the plugin")
+            if plugin.api_version != PLUGIN_API_VERSION:
+                del self._plugins[plugin_name]
+                raise ImportError(
+                    f"erasure-code plugin {plugin_name}: api version "
+                    f"{plugin.api_version} != {PLUGIN_API_VERSION}")
+            return plugin
+
+    def factory(
+        self,
+        plugin_name: str,
+        profile: ErasureCodeProfile,
+        directory: str | None = None,
+    ) -> ErasureCodeInterface:
+        """Load (if needed) and instantiate a codec; verify profile echo."""
+        plugin = self._plugins.get(plugin_name)
+        if plugin is None:
+            plugin = self.load(plugin_name, directory)
+        codec = plugin.factory(profile)
+        echoed = codec.get_profile()
+        for key, val in profile.items():
+            if key not in echoed:
+                raise ValueError(
+                    f"plugin {plugin_name} profile lost key {key}={val}")
+        return codec
+
+    def preload(self, plugins: list[str], directory: str | None = None) -> None:
+        """global_init_preload_erasure_code analog (global_init.cc:593)."""
+        for name in plugins:
+            self.load(name, directory)
+
+
+_instance: ErasureCodePluginRegistry | None = None
+_instance_lock = threading.Lock()
+
+
+def instance() -> ErasureCodePluginRegistry:
+    global _instance
+    if _instance is None:
+        with _instance_lock:
+            if _instance is None:
+                _instance = ErasureCodePluginRegistry()
+    return _instance

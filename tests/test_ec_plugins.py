@@ -1,0 +1,144 @@
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodePluginRegistry
+from ceph_tpu.ec.base import SIMD_ALIGN
+
+
+@pytest.fixture()
+def registry():
+    return ErasureCodePluginRegistry()
+
+
+def rand_bytes(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, size=n,
+                                                dtype=np.uint8).tobytes()
+
+
+def test_registry_load_and_factory(registry):
+    codec = registry.factory("isa", {"k": "8", "m": "3",
+                                     "technique": "reed_sol_van"})
+    assert codec.get_chunk_count() == 11
+    assert codec.get_data_chunk_count() == 8
+
+
+def test_registry_unknown_plugin(registry):
+    with pytest.raises(FileNotFoundError):
+        registry.factory("doesnotexist", {})
+
+
+def test_registry_profile_echo(registry):
+    profile = {"k": "4", "m": "2", "technique": "cauchy"}
+    codec = registry.factory("isa", profile)
+    for key in profile:
+        assert key in codec.get_profile()
+
+
+def test_isa_chunk_size(registry):
+    codec = registry.factory("isa", {"k": "8", "m": "3"})
+    # ceil(stripe/k) rounded up to 32 (ErasureCodeIsa.cc:66-79)
+    assert codec.get_chunk_size(4096) == 512
+    assert codec.get_chunk_size(4097) == 544
+    assert codec.get_chunk_size(100) == 32
+    assert codec.get_chunk_size(8 * 32) == 32
+
+
+def test_isa_vandermonde_parity0_is_xor(registry):
+    """The first Vandermonde parity row is all ones => parity0 == XOR of
+    the data chunks.  Independent structural check of byte parity."""
+    codec = registry.factory("isa", {"k": "8", "m": "3"})
+    data = rand_bytes(8 * 512)
+    encoded = codec.encode(set(range(11)), data)
+    arr = np.frombuffer(data, dtype=np.uint8).reshape(8, 512)
+    want = np.zeros(512, dtype=np.uint8)
+    for row in arr:
+        want ^= row
+    assert np.array_equal(encoded[8], want)
+
+
+def test_isa_encode_padding(registry):
+    codec = registry.factory("isa", {"k": "4", "m": "2"})
+    raw = rand_bytes(100)
+    encoded = codec.encode(set(range(6)), raw)
+    bs = codec.get_chunk_size(100)
+    assert bs == 32
+    got = b"".join(bytes(encoded[i]) for i in range(4))
+    assert got[:100] == raw
+    assert got[100:] == b"\x00" * (4 * bs - 100)
+
+
+@pytest.mark.parametrize("plugin,profile", [
+    ("isa", {"k": "8", "m": "3", "technique": "reed_sol_van"}),
+    ("isa", {"k": "10", "m": "4", "technique": "cauchy"}),
+    ("jerasure", {"k": "7", "m": "3", "technique": "reed_sol_van"}),
+    ("jerasure", {"k": "6", "m": "2", "technique": "reed_sol_r6_op"}),
+    ("example", {}),
+])
+def test_roundtrip_all_single_and_double_erasures(registry, plugin, profile):
+    codec = registry.factory(plugin, profile)
+    k = codec.get_data_chunk_count()
+    n = codec.get_chunk_count()
+    m = n - k
+    data = rand_bytes(k * 128 + 17, seed=42)
+    encoded = codec.encode(set(range(n)), data)
+    assert len(encoded) == n
+
+    patterns = [[e] for e in range(n)]
+    if m >= 2:
+        patterns += [[a, b] for a in range(n) for b in range(a + 1, n)]
+    for erased in patterns:
+        avail = {i: encoded[i] for i in range(n) if i not in erased}
+        decoded = codec.decode(set(range(n)), avail)
+        for e in erased:
+            assert np.array_equal(decoded[e], encoded[e]), (plugin, erased)
+
+
+def test_decode_concat_roundtrip(registry):
+    codec = registry.factory("isa", {"k": "8", "m": "3"})
+    data = rand_bytes(8 * 512)
+    encoded = codec.encode(set(range(11)), data)
+    avail = {i: encoded[i] for i in range(11) if i not in (0, 9)}
+    assert codec.decode_concat(avail)[:len(data)] == data
+
+
+def test_minimum_to_decode(registry):
+    codec = registry.factory("isa", {"k": "4", "m": "2"})
+    # all wanted available -> identity
+    got = codec.minimum_to_decode({0, 1}, {0, 1, 2, 3, 4, 5})
+    assert set(got) == {0, 1}
+    # one lost -> first k of the available
+    got = codec.minimum_to_decode({0, 1, 2, 3}, {1, 2, 3, 4, 5})
+    assert set(got) == {1, 2, 3, 4}
+    # too few -> error
+    with pytest.raises(IOError):
+        codec.minimum_to_decode({0}, {1, 2, 3})
+
+
+def test_decode_table_cache(registry):
+    codec = registry.factory("isa", {"k": "4", "m": "2"})
+    data = rand_bytes(4 * 64)
+    encoded = codec.encode(set(range(6)), data)
+    avail = {i: encoded[i] for i in range(6) if i != 1}
+    codec.decode(set(range(6)), avail)
+    codec.decode(set(range(6)), avail)
+    assert codec.tcache.hits >= 1
+    assert codec.tcache.misses == 1
+
+
+def test_jerasure_raid6_forces_m2(registry):
+    codec = registry.factory("jerasure",
+                             {"k": "5", "m": "7",
+                              "technique": "reed_sol_r6_op"})
+    assert codec.get_chunk_count() - codec.get_data_chunk_count() == 2
+
+
+def test_chunk_mapping_profile(registry):
+    codec = registry.factory("isa", {"k": "2", "m": "1", "mapping": "_DD"})
+    # data chunks land at positions 1,2; coding at 0
+    assert codec.get_chunk_mapping() == [1, 2, 0]
+    data = rand_bytes(2 * 32)
+    encoded = codec.encode({0, 1, 2}, data)
+    arr = np.frombuffer(data, dtype=np.uint8).reshape(2, 32)
+    assert np.array_equal(encoded[1], arr[0])
+    assert np.array_equal(encoded[2], arr[1])
+    assert np.array_equal(encoded[0], arr[0] ^ arr[1])

@@ -1,0 +1,8 @@
+"""TPU compute kernels (JAX/XLA/Pallas) for the erasure-code hot path."""
+
+from .gf2kernels import (  # noqa: F401
+    gf_matmul_device,
+    gf_matmul_batch_device,
+    bitmatrix_i8,
+    clear_kernel_cache,
+)

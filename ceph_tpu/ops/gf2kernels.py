@@ -1,0 +1,199 @@
+"""GF(2^8) matrix multiply as GF(2) bit-matmul on the TPU MXU.
+
+The reference's hot loop is ISA-L's ``ec_encode_data`` -- an (r,k) GF(2^8)
+coefficient matrix applied to k data chunks (src/erasure-code/isa/
+ErasureCodeIsa.cc:128, called from the OSD write path via ECUtil::encode,
+src/osd/ECUtil.cc:134).  On TPU we reformulate: multiplication by a GF(2^8)
+constant is linear over GF(2), so the whole stripe encode is
+
+    parity_bits(8r, N) = W(8r, 8k) @ data_bits(8k, N)  (mod 2)
+
+with W the bit-expanded coefficient matrix.  That is a plain int8 matmul --
+exactly what the MXU does -- plus cheap VPU unpack/pack around it.  Batching
+thousands of stripes makes N huge, which is the regime the systolic array
+wants.  Byte-identical to the host/numpy path by construction.
+
+Two executions are provided:
+  * XLA path (`_gf_matmul_xla`): portable, used on CPU and as fallback.
+  * Pallas path (`_gf_matmul_pallas`): fuses unpack+dot+pack per VMEM tile
+    so HBM traffic is just bytes in / parity out.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..gf.gf8 import matrix_to_bitmatrix
+
+# column-tile width for the pallas kernel; also the padding bucket for the
+# XLA path so recompiles stay bounded
+LANE_TILE = 8192
+
+
+@functools.lru_cache(maxsize=256)
+def _bitmatrix_cached(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
+    mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
+    return matrix_to_bitmatrix(mat).astype(np.int8)
+
+
+def bitmatrix_i8(matrix: np.ndarray) -> np.ndarray:
+    """(r,k) GF coefficient matrix -> (8r,8k) int8 GF(2) matrix (cached)."""
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    return _bitmatrix_cached(matrix.tobytes(), *matrix.shape)
+
+
+def _unpack_bits(data: jnp.ndarray) -> jnp.ndarray:
+    """(k, N) uint8 -> (8k, N) int8 bit planes.
+
+    Plane order matches matrix_to_bitmatrix: row 8j+s is bit s of chunk j.
+    (bit 0 of an arithmetic right shift by s == bit s, for any sign.)
+    """
+    k = data.shape[0]
+    planes = [((data >> s) & 1) for s in range(8)]
+    # interleave to (k, 8, N) then flatten; stacking then reshape keeps the
+    # 8j+s row order
+    stacked = jnp.stack(planes, axis=1)  # (k, 8, N)
+    return stacked.reshape(8 * k, data.shape[1]).astype(jnp.int8)
+
+
+def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(8r, N) int32 bit rows (already mod 2) -> (r, N) uint8."""
+    r8, n = bits.shape
+    r = r8 // 8
+    b = bits.reshape(r, 8, n)
+    shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
+    return (b << shifts).sum(axis=1).astype(jnp.uint8)
+
+
+def _gf_matmul_math(w: jnp.ndarray, data_u8: jnp.ndarray) -> jnp.ndarray:
+    bits = _unpack_bits(data_u8.astype(jnp.uint8))
+    acc = jax.lax.dot_general(
+        w, bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return _pack_bits(acc & 1)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _gf_matmul_xla(w: jnp.ndarray, data_u8: jnp.ndarray) -> jnp.ndarray:
+    return _gf_matmul_math(w, data_u8)
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused kernel
+# ---------------------------------------------------------------------------
+
+def _make_pallas_fn(r8: int, k: int, n: int, tile: int,
+                    interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(w_ref, data_ref, out_ref):
+        # Mosaic has no i8 shrui; widen to i32 for the bit extraction
+        data = data_ref[:].astype(jnp.int32)  # (k, tile)
+        planes = [((data >> s) & 1) for s in range(8)]
+        stacked = jnp.stack(planes, axis=1).reshape(8 * k, tile).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            w_ref[:], stacked,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ) & 1
+        r = r8 // 8
+        b = acc.reshape(r, 8, tile)
+        shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
+        out_ref[:] = (b << shifts).sum(axis=1).astype(jnp.uint8)
+
+    grid = (n // tile,)
+    fn = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((r8 // 8, n), jnp.uint8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((r8, 8 * k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r8 // 8, tile), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=512)
+def _compiled(r8: int, k: int, n_padded: int, use_pallas: bool):
+    if use_pallas:
+        interpret = bool(os.environ.get("CEPH_TPU_PALLAS_INTERPRET"))
+        return _make_pallas_fn(r8, k, n_padded, min(LANE_TILE, n_padded),
+                               interpret=interpret)
+    return _gf_matmul_xla
+
+
+def clear_kernel_cache() -> None:
+    _compiled.cache_clear()
+    _bitmatrix_cached.cache_clear()
+
+
+def _want_pallas() -> bool:
+    if os.environ.get("CEPH_TPU_NO_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def _pad_n(n: int) -> int:
+    # bucket N so the jit cache stays small: pad to LANE_TILE multiples,
+    # with a small-size bucket ladder below one tile
+    if n >= LANE_TILE:
+        return ((n + LANE_TILE - 1) // LANE_TILE) * LANE_TILE
+    b = 512
+    while b < n:
+        b *= 2
+    return b
+
+
+def gf_matmul_device(matrix: np.ndarray, data, *, out_np: bool = True):
+    """(r,k) GF(2^8) coeff matrix x (k,N) bytes -> (r,N) bytes, on device.
+
+    ``data`` may be a numpy array or a device array; the result is returned
+    as numpy when out_np (plugin path) or left on device (bench path).
+    """
+    w = bitmatrix_i8(matrix)
+    r8, k8 = w.shape
+    k = k8 // 8
+    n = data.shape[1]
+    n_pad = _pad_n(n)
+    use_pallas = _want_pallas() and n_pad % 128 == 0
+    fn = _compiled(r8, k, n_pad, use_pallas)
+    xd = jnp.asarray(data, dtype=jnp.uint8)
+    if n_pad != n:
+        xd = jnp.pad(xd, ((0, 0), (0, n_pad - n)))
+    out = fn(jnp.asarray(w), xd)
+    if n_pad != n:
+        out = out[:, :n]
+    return np.asarray(out) if out_np else out
+
+
+def gf_matmul_batch_device(matrix: np.ndarray, data, *, out_np: bool = False):
+    """Batched stripes: (B, k, L) -> (B, r, L).
+
+    Columns are independent, so the batch folds into the lane dimension:
+    (B,k,L) -> transpose (k,B,L) -> (k, B*L) -> matmul -> unfold.
+    """
+    b, k, l = data.shape
+    xd = jnp.asarray(data, dtype=jnp.uint8)
+    flat = xd.transpose(1, 0, 2).reshape(k, b * l)
+    out = gf_matmul_device(matrix, flat, out_np=False)
+    r = out.shape[0]
+    out = out.reshape(r, b, l).transpose(1, 0, 2)
+    return np.asarray(out) if out_np else out

@@ -1,0 +1,193 @@
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (
+    crush_hash32, crush_hash32_2, crush_hash32_3,
+    ceph_str_hash_rjenkins, crush_ln, crush_do_rule,
+    build_flat_map, build_two_level_map,
+    CRUSH_ITEM_NONE,
+)
+from ceph_tpu.crush.hashes import crush_hash32_2_np, crush_hash32_3_np
+from ceph_tpu.crush.ln import crush_ln_np, RH_LH_TBL, LL_TBL
+from ceph_tpu.crush.types import Bucket, CrushMap, Rule, RuleStep
+from ceph_tpu.crush import types as T
+
+
+def c_ref_hash3(a, b, c):
+    """Independent reimplementation used as oracle (checked against the
+    published crush constants)."""
+    M = 0xFFFFFFFF
+
+    def mix(a, b, c):
+        a = (a - b - c) & M; a ^= c >> 13
+        b = (b - c - a) & M; b = (b ^ (a << 8)) & M
+        c = (c - a - b) & M; c ^= b >> 13
+        a = (a - b - c) & M; a ^= c >> 12
+        b = (b - c - a) & M; b = (b ^ (a << 16)) & M
+        c = (c - a - b) & M; c ^= b >> 5
+        a = (a - b - c) & M; a ^= c >> 3
+        b = (b - c - a) & M; b = (b ^ (a << 10)) & M
+        c = (c - a - b) & M; c ^= b >> 15
+        return a, b, c
+
+    h = (1315423911 ^ a ^ b ^ c) & M
+    x, y = 231232, 1232
+    a, b, h = mix(a, b, h)
+    c, x, h = mix(c, x, h)
+    y, a, h = mix(y, a, h)
+    b, x, h = mix(b, x, h)
+    y, c, h = mix(y, c, h)
+    return h
+
+
+def test_hash3_against_independent_impl():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(v) for v in rng.integers(0, 2**32, size=3))
+        assert crush_hash32_3(a, b, c) == c_ref_hash3(a, b, c)
+
+
+def test_hash_determinism_and_spread():
+    vals = {crush_hash32(i) for i in range(1000)}
+    assert len(vals) == 1000  # no collisions in small range
+    assert crush_hash32_2(1, 2) != crush_hash32_2(2, 1)
+
+
+def test_vectorized_hashes_match_scalar():
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, 2**32, size=257, dtype=np.uint32)
+    b = rng.integers(0, 2**32, size=257, dtype=np.uint32)
+    c = rng.integers(0, 2**32, size=257, dtype=np.uint32)
+    h2 = crush_hash32_2_np(a, b)
+    h3 = crush_hash32_3_np(a, b, c)
+    for i in range(0, 257, 41):
+        assert int(h2[i]) == crush_hash32_2(int(a[i]), int(b[i]))
+        assert int(h3[i]) == crush_hash32_3(int(a[i]), int(b[i]), int(c[i]))
+
+
+def test_str_hash_known_properties():
+    # deterministic, length-sensitive, order-sensitive
+    assert ceph_str_hash_rjenkins(b"foo") == ceph_str_hash_rjenkins(b"foo")
+    assert ceph_str_hash_rjenkins(b"foo") != ceph_str_hash_rjenkins(b"oof")
+    assert ceph_str_hash_rjenkins(b"") != ceph_str_hash_rjenkins(b"\x00")
+    # exercise all tail lengths
+    seen = set()
+    for n in range(30):
+        seen.add(ceph_str_hash_rjenkins(bytes(range(n))))
+    assert len(seen) == 30
+
+
+def test_crush_ln_tables_shape():
+    assert RH_LH_TBL.shape == (258,)
+    assert LL_TBL.shape == (256,)
+    # documented formula sanity: RH_LH[2k] ~ 2^48/(1+k/128) within 1 ulp-ish
+    for k in (0, 1, 64, 127):
+        approx = (2.0**48) / (1.0 + k / 128.0)
+        assert abs(int(RH_LH_TBL[2 * k]) - approx) <= 2
+
+
+def test_crush_ln_monotonic_and_range():
+    prev = -1
+    for u in range(0, 0x10000, 257):
+        v = crush_ln(u)
+        assert v > prev
+        prev = v
+    assert crush_ln(0) == 0
+    # ~log2(0x10000)<<44, with the table's historical LH[128]=0xffff00000000
+    # quirk (slightly under 2^48)
+    assert crush_ln(0xFFFF) == 0xFFFFF0000000
+
+
+def test_crush_ln_np_matches_scalar():
+    us = list(range(0, 0x10000, 97)) + [0, 1, 0xFFFF, 0x7FFF, 0x8000]
+    got = crush_ln_np(np.array(us))
+    for u, g in zip(us, got):
+        assert int(g) == crush_ln(u), u
+
+
+def test_flat_map_basic_mapping():
+    m = build_flat_map(10)
+    out = crush_do_rule(m, 0, x=1234, result_max=3,
+                        weights=[0x10000] * 10)
+    assert len(out) == 3
+    assert len(set(out)) == 3
+    assert all(0 <= o < 10 for o in out)
+    # determinism
+    assert out == crush_do_rule(m, 0, x=1234, result_max=3,
+                                weights=[0x10000] * 10)
+
+
+def test_flat_map_distribution():
+    """Statistical: straw2 respects weights roughly proportionally."""
+    n = 8
+    weights = [0x10000] * n
+    m = build_flat_map(n)
+    counts = np.zeros(n)
+    for x in range(4000):
+        for o in crush_do_rule(m, 0, x=x, result_max=1, weights=weights):
+            counts[o] += 1
+    assert counts.min() > 0.7 * counts.mean()
+    assert counts.max() < 1.3 * counts.mean()
+
+
+def test_two_level_failure_domain():
+    """chooseleaf firstn over hosts => no two replicas on one host."""
+    m = build_two_level_map(6, 4)
+    weights = [0x10000] * 24
+    for x in range(500):
+        out = crush_do_rule(m, 0, x=x, result_max=3, weights=weights)
+        assert len(out) == 3
+        hosts = {o // 4 for o in out}
+        assert len(hosts) == 3, (x, out)
+
+
+def test_indep_rule_stable_positions():
+    """indep: erasing an OSD must not shift other positions."""
+    m = build_two_level_map(8, 2)
+    weights = [0x10000] * 16
+    x = 42
+    before = crush_do_rule(m, 1, x=x, result_max=5, weights=weights)
+    assert len(before) == 5
+    victim = before[2]
+    w2 = list(weights)
+    w2[victim] = 0
+    after = crush_do_rule(m, 1, x=x, result_max=5, weights=w2)
+    for i in range(5):
+        if i != 2:
+            assert after[i] == before[i], (before, after)
+    assert after[2] != victim
+
+
+def test_out_osd_remapped():
+    m = build_flat_map(10)
+    weights = [0x10000] * 10
+    out1 = crush_do_rule(m, 0, x=7, result_max=3, weights=weights)
+    victim = out1[0]
+    weights[victim] = 0
+    out2 = crush_do_rule(m, 0, x=7, result_max=3, weights=weights)
+    assert victim not in out2
+    assert len(out2) == 3
+
+
+def test_uniform_bucket_mapping():
+    m = build_flat_map(10, alg=T.CRUSH_BUCKET_UNIFORM)
+    weights = [0x10000] * 10
+    out = crush_do_rule(m, 0, x=99, result_max=4, weights=weights)
+    assert len(out) == 4
+    assert len(set(out)) == 4
+
+
+def test_list_bucket_mapping():
+    m = build_flat_map(6, alg=T.CRUSH_BUCKET_LIST)
+    weights = [0x10000] * 6
+    out = crush_do_rule(m, 0, x=3, result_max=2, weights=weights)
+    assert len(out) == 2 and len(set(out)) == 2
+
+
+def test_weight_zero_bucket_item_skipped():
+    """A host with straw2 weight 0 never gets chosen."""
+    m = build_two_level_map(4, 2, host_weights=[0x20000, 0x20000, 0, 0x20000])
+    weights = [0x10000] * 8
+    for x in range(200):
+        out = crush_do_rule(m, 0, x=x, result_max=3, weights=weights)
+        assert all(o // 2 != 2 for o in out), (x, out)

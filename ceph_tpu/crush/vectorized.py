@@ -1,0 +1,359 @@
+"""Vectorized CRUSH on TPU: bulk PG->OSD mapping as one XLA launch.
+
+The reference recomputes full-cluster mappings on host thread pools
+(ParallelPGMapper, src/osd/OSDMapMapping.h:18; used by the balancer and
+OSDMonitor's PrimeTempJob).  Here the whole job is one data-parallel
+program over the PG axis: straw2 draws become gathers into the fixed-point
+log tables plus an argmax, and the firstn/indep retry loops become bounded
+`lax.while_loop`s with per-lane masks -- decision-identical to the scalar
+mapper (ceph_tpu/crush/mapper.py), which is itself pinned to mapper.c.
+
+Supported map shape for the fused path: straw2 hierarchies of depth 1
+(root->osds) or 2 (root->hosts->osds) with the standard replicated
+(chooseleaf firstn) / erasure (chooseleaf indep) rules and jewel tunables.
+Anything else falls back to the scalar engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+
+# straw2 draws are 64-bit fixed-point; everything here uses explicit dtypes
+# so the global x64 switch is safe for the rest of the package
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from .ln import RH_LH_TBL, LL_TBL  # noqa: E402
+from .types import (
+    CrushMap,
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_ITEM_NONE,
+    CRUSH_RULE_TAKE,
+    CRUSH_RULE_EMIT,
+    CRUSH_RULE_CHOOSE_FIRSTN,
+    CRUSH_RULE_CHOOSE_INDEP,
+    CRUSH_RULE_CHOOSELEAF_FIRSTN,
+    CRUSH_RULE_CHOOSELEAF_INDEP,
+    CRUSH_RULE_SET_CHOOSE_TRIES,
+    CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+)
+
+S64_MIN = jnp.int64(-(2**63))
+CRUSH_HASH_SEED = np.uint32(1315423911)
+
+
+def _u32(v):
+    return jnp.asarray(v, dtype=jnp.uint32)
+
+
+def _mix(a, b, c):
+    a = a - b; a = a - c; a = a ^ (c >> 13)
+    b = b - c; b = b - a; b = b ^ (a << 8)
+    c = c - a; c = c - b; c = c ^ (b >> 13)
+    a = a - b; a = a - c; a = a ^ (c >> 12)
+    b = b - c; b = b - a; b = b ^ (a << 16)
+    c = c - a; c = c - b; c = c ^ (b >> 5)
+    a = a - b; a = a - c; a = a ^ (c >> 3)
+    b = b - c; b = b - a; b = b ^ (a << 10)
+    c = c - a; c = c - b; c = c ^ (b >> 15)
+    return a, b, c
+
+
+def hash32_2_jnp(a, b):
+    a, b = _u32(a), _u32(b)
+    h = _u32(CRUSH_HASH_SEED) ^ a ^ b
+    x = jnp.full_like(h, 231232)
+    y = jnp.full_like(h, 1232)
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
+    return h
+
+
+def hash32_3_jnp(a, b, c):
+    a, b, c = _u32(a), _u32(b), _u32(c)
+    a, b, c = jnp.broadcast_arrays(a, b, c)
+    h = _u32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+    x = jnp.full_like(h, 231232)
+    y = jnp.full_like(h, 1232)
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return h
+
+
+_RH_LH = jnp.asarray(RH_LH_TBL)   # int64 (258,)
+_LL = jnp.asarray(LL_TBL)         # int64 (256,)
+
+
+def crush_ln_jnp(u):
+    """Vector crush_ln over int32 u in [0, 0xffff] -> int64."""
+    x = u.astype(jnp.int64) + 1
+    need = (x & 0x18000) == 0
+    masked = (x & 0x1FFFF).astype(jnp.int32)
+    # bit_length via 31 - clz
+    bl = 32 - jax.lax.clz(masked)
+    bits = jnp.where(need, 16 - bl, 0).astype(jnp.int64)
+    x = x << bits
+    iexpon = (15 - bits).astype(jnp.int64)
+    index1 = ((x >> 8) << 1).astype(jnp.int32)
+    rh = _RH_LH[index1 - 256]
+    lh = _RH_LH[index1 + 1 - 256]
+    xl64 = (x * rh) >> 48
+    index2 = (xl64 & 0xFF).astype(jnp.int32)
+    ll = _LL[index2]
+    return (iexpon << 44) + ((lh + ll) >> 4)
+
+
+def straw2_draws(x, item_ids, r, weights):
+    """Draw values for one bucket: shapes broadcast over (..., n_items).
+
+    x: (...,) int32 lanes; item_ids/weights: (..., n) int32.
+    Returns (..., n) int64 draws (S64_MIN where weight==0).
+    """
+    u = (hash32_3_jnp(x[..., None], item_ids, r[..., None])
+         & np.uint32(0xFFFF)).astype(jnp.int32)
+    ln = crush_ln_jnp(u) - jnp.int64(0x1000000000000)
+    w = weights.astype(jnp.int64)
+    draws = jax.lax.div(ln, jnp.maximum(w, 1))
+    return jnp.where(w > 0, draws, S64_MIN)
+
+
+def is_out_jnp(osd_weights, item, x):
+    """Vector is_out (mapper.c:419-433): weight is 16.16 reweight."""
+    w = osd_weights[item]
+    h = hash32_2_jnp(x, item.astype(jnp.uint32)) & np.uint32(0xFFFF)
+    probably_out = h.astype(jnp.int32) >= w
+    return jnp.where(w >= 0x10000, False,
+                     jnp.where(w == 0, True, probably_out))
+
+
+@dataclass
+class CompiledMap:
+    """Flattened straw2 hierarchy for the fused path."""
+
+    depth: int                      # 1 or 2
+    host_ids: np.ndarray            # (H,) int32 bucket ids (depth2) / osd ids
+    host_weights: np.ndarray        # (H,) int32 16.16
+    leaf_items: np.ndarray | None   # (H, max_per_host) int32, -pad
+    leaf_weights: np.ndarray | None
+    max_devices: int
+
+    @classmethod
+    def from_map(cls, crush_map: CrushMap, root_id: int) -> "CompiledMap":
+        root = crush_map.buckets[root_id]
+        if root.alg != CRUSH_BUCKET_STRAW2:
+            raise ValueError("fused path requires straw2 buckets")
+        children = [crush_map.buckets.get(i) for i in root.items]
+        if all(c is None for c in children):
+            return cls(1, np.asarray(root.items, np.int32),
+                       np.asarray(root.item_weights, np.int32),
+                       None, None, crush_map.max_devices)
+        if any(c is None for c in children):
+            raise ValueError("mixed osd/bucket children unsupported")
+        for c in children:
+            if c.alg != CRUSH_BUCKET_STRAW2:
+                raise ValueError("fused path requires straw2 buckets")
+            if any(i < 0 for i in c.items):
+                raise ValueError("fused path supports depth <= 2")
+        maxn = max(c.size for c in children)
+        li = np.zeros((len(children), maxn), np.int32)
+        lw = np.zeros((len(children), maxn), np.int32)
+        for j, c in enumerate(children):
+            li[j, :c.size] = c.items
+            li[j, c.size:] = c.items[0] if c.items else 0
+            lw[j, :c.size] = c.item_weights
+        return cls(2, np.asarray(root.items, np.int32),
+                   np.asarray(root.item_weights, np.int32),
+                   li, lw, crush_map.max_devices)
+
+
+def _rule_shape(crush_map: CrushMap, ruleno: int):
+    """Parse a rule into (root_id, firstn, leaf, choose_tries, leaf_tries)."""
+    rule = crush_map.rules[ruleno]
+    t = crush_map.tunables
+    choose_tries = t.choose_total_tries + 1
+    leaf_tries = 0
+    root_id = None
+    mode = None
+    for step in rule.steps:
+        if step.op == CRUSH_RULE_SET_CHOOSE_TRIES:
+            choose_tries = step.arg1
+        elif step.op == CRUSH_RULE_SET_CHOOSELEAF_TRIES:
+            leaf_tries = step.arg1
+        elif step.op == CRUSH_RULE_TAKE:
+            root_id = step.arg1
+        elif step.op in (CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                         CRUSH_RULE_CHOOSE_INDEP, CRUSH_RULE_CHOOSELEAF_INDEP):
+            mode = step.op
+        elif step.op == CRUSH_RULE_EMIT:
+            pass
+    firstn = mode in (CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSELEAF_FIRSTN)
+    leaf = mode in (CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP)
+    return root_id, firstn, leaf, choose_tries, leaf_tries
+
+
+class VectorCrush:
+    """Bulk mapper for one (map, rule) pair."""
+
+    def __init__(self, crush_map: CrushMap, ruleno: int) -> None:
+        root_id, firstn, leaf, choose_tries, leaf_tries = _rule_shape(
+            crush_map, ruleno)
+        self.cm = CompiledMap.from_map(crush_map, root_id)
+        if leaf and self.cm.depth != 2:
+            raise ValueError("chooseleaf rule needs a depth-2 map")
+        if not leaf and self.cm.depth != 1:
+            raise ValueError("plain choose rule needs a depth-1 map")
+        t = crush_map.tunables
+        self.firstn = firstn
+        self.choose_tries = choose_tries
+        self.leaf_tries = leaf_tries
+        self.vary_r = t.chooseleaf_vary_r
+        self.stable = t.chooseleaf_stable
+        self.descend_once = t.chooseleaf_descend_once
+        if firstn:
+            self.recurse_tries = (leaf_tries if leaf_tries
+                                  else (1 if self.descend_once
+                                        else choose_tries))
+        else:
+            self.recurse_tries = leaf_tries if leaf_tries else 1
+        if not self.stable or self.vary_r != 1:
+            # scalar fallback covers other tunable profiles
+            raise ValueError("fused path implements jewel tunables")
+
+    # -- firstn -------------------------------------------------------------
+    @partial(jax.jit, static_argnames=("self", "numrep"))
+    def map_firstn(self, xs: jnp.ndarray, numrep: int,
+                   osd_weights: jnp.ndarray) -> jnp.ndarray:
+        """xs: (L,) int32 placement seeds -> (L, numrep) osd ids (or NONE)."""
+        cm = self.cm
+        L = xs.shape[0]
+        host_ids = jnp.asarray(cm.host_ids)
+        host_w = jnp.asarray(cm.host_weights)
+        out = jnp.full((L, numrep), CRUSH_ITEM_NONE, jnp.int32)
+        out_hosts = jnp.full((L, numrep), jnp.int32(2**31 - 1), jnp.int32)
+
+        def pick_leaf(x, host_idx, r):
+            if cm.depth == 1:
+                osd = host_ids[host_idx]
+                return osd
+            litems = jnp.asarray(cm.leaf_items)[host_idx]
+            lw = jnp.asarray(cm.leaf_weights)[host_idx]
+            draws = straw2_draws(x, litems, r, lw)
+            return litems[jnp.arange(L), jnp.argmax(draws, axis=-1)]
+
+        for rep in range(numrep):
+            # per-lane retry loop: state = (ftotal, done, host_idx, osd)
+            def cond(state):
+                ftotal, done, _, _ = state
+                return jnp.any(~done & (ftotal < self.choose_tries))
+
+            def body(state):
+                ftotal, done, host_idx, osd = state
+                r = (rep + ftotal).astype(jnp.int32)
+                draws = straw2_draws(
+                    xs, jnp.broadcast_to(host_ids, (L, host_ids.shape[0])),
+                    r, jnp.broadcast_to(host_w, (L, host_w.shape[0])))
+                cand_idx = jnp.argmax(draws, axis=-1).astype(jnp.int32)
+                # collision vs previously placed hosts in this take block
+                collide = jnp.zeros((L,), bool)
+                for j in range(rep):
+                    collide |= out_hosts[:, j] == cand_idx
+                # descend to leaf: sub_r = r >> (vary_r - 1) = r
+                cand_osd = pick_leaf(xs, cand_idx, r)
+                reject = is_out_jnp(osd_weights, cand_osd, xs)
+                if cm.depth == 2:
+                    for j in range(rep):
+                        reject |= out[:, j] == cand_osd
+                ok = ~done & ~collide & ~reject
+                host_idx = jnp.where(ok, cand_idx, host_idx)
+                osd = jnp.where(ok, cand_osd, osd)
+                newdone = done | ok
+                ftotal = jnp.where(~newdone, ftotal + 1, ftotal)
+                return ftotal, newdone, host_idx, osd
+
+            init = (jnp.zeros((L,), jnp.int32), jnp.zeros((L,), bool),
+                    jnp.full((L,), 2**31 - 1, jnp.int32),
+                    jnp.full((L,), CRUSH_ITEM_NONE, jnp.int32))
+            ftotal, done, host_idx, osd = jax.lax.while_loop(cond, body, init)
+            out = out.at[:, rep].set(jnp.where(done, osd, CRUSH_ITEM_NONE))
+            out_hosts = out_hosts.at[:, rep].set(
+                jnp.where(done, host_idx, 2**31 - 1))
+        return out
+
+    # -- indep --------------------------------------------------------------
+    @partial(jax.jit, static_argnames=("self", "numrep"))
+    def map_indep(self, xs: jnp.ndarray, numrep: int,
+                  osd_weights: jnp.ndarray) -> jnp.ndarray:
+        cm = self.cm
+        L = xs.shape[0]
+        host_ids = jnp.asarray(cm.host_ids)
+        host_w = jnp.asarray(cm.host_weights)
+        UNDEF = jnp.int32(0x7FFFFFFE)
+
+        def leaf_try(x, host_idx, parent_r, rep):
+            """indep recursion: up to recurse_tries rounds for one slot."""
+            litems = jnp.asarray(cm.leaf_items)[host_idx]
+            lw = jnp.asarray(cm.leaf_weights)[host_idx]
+            osd = jnp.full((L,), CRUSH_ITEM_NONE, jnp.int32)
+            found = jnp.zeros((L,), bool)
+            for ft in range(self.recurse_tries):
+                r_leaf = (rep + parent_r + numrep * ft).astype(jnp.int32)
+                draws = straw2_draws(x, litems, r_leaf, lw)
+                cand = litems[jnp.arange(L), jnp.argmax(draws, axis=-1)]
+                ok = ~found & ~is_out_jnp(osd_weights, cand, x)
+                osd = jnp.where(ok, cand, osd)
+                found |= ok
+            return osd, found
+
+        def cond(state):
+            ftotal, out_h, out_o = state
+            return (ftotal < self.choose_tries) & jnp.any(out_h == UNDEF)
+
+        def body(state):
+            ftotal, out_h, out_o = state
+            for rep in range(numrep):
+                slot_undef = out_h[:, rep] == UNDEF
+                r = (rep + numrep * ftotal).astype(jnp.int32)
+                draws = straw2_draws(
+                    xs, jnp.broadcast_to(host_ids, (L, host_ids.shape[0])),
+                    r, jnp.broadcast_to(host_w, (L, host_w.shape[0])))
+                cand_idx = jnp.argmax(draws, axis=-1).astype(jnp.int32)
+                if cm.depth == 1:
+                    # flat: slots hold osd ids; compare apples to apples
+                    cand_idx = host_ids[cand_idx]
+                collide = jnp.zeros((L,), bool)
+                for j in range(numrep):
+                    collide |= out_h[:, j] == cand_idx
+                if cm.depth == 2:
+                    osd, found = leaf_try(xs, cand_idx, r, rep)
+                else:
+                    osd = cand_idx
+                    found = ~is_out_jnp(osd_weights, osd, xs)
+                ok = slot_undef & ~collide & found
+                out_h = out_h.at[:, rep].set(
+                    jnp.where(ok, cand_idx, out_h[:, rep]))
+                out_o = out_o.at[:, rep].set(
+                    jnp.where(ok, osd, out_o[:, rep]))
+            return ftotal + 1, out_h, out_o
+
+        init = (jnp.int32(0),
+                jnp.full((L, numrep), UNDEF, jnp.int32),
+                jnp.full((L, numrep), UNDEF, jnp.int32))
+        _, out_h, out_o = jax.lax.while_loop(cond, body, init)
+        return jnp.where(out_o == UNDEF, CRUSH_ITEM_NONE, out_o)
+
+    def map_pgs(self, xs, numrep: int, osd_weights) -> np.ndarray:
+        xs = jnp.asarray(xs, jnp.int32)
+        w = jnp.asarray(osd_weights, jnp.int32)
+        if self.firstn:
+            return np.asarray(self.map_firstn(xs, numrep, w))
+        return np.asarray(self.map_indep(xs, numrep, w))

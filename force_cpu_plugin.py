@@ -1,0 +1,21 @@
+"""Early pytest plugin: re-exec the test run with TPU plugin env scrubbed.
+
+Loaded via pytest.ini addopts (-p force_cpu_plugin), which happens BEFORE
+pytest installs fd-level output capture and before any conftest runs, so
+the exec'd child owns the real stdout.  Needed because the interpreter may
+boot with a remote-TPU PJRT plugin (axon sitecustomize) that can block the
+whole process on a device claim even for CPU-only test work.
+"""
+
+import os
+import sys
+
+if os.environ.get("PALLAS_AXON_POOL_IPS") and not os.environ.get(
+        "CEPH_TPU_TEST_REEXEC"):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["PALLAS_AXON_REMOTE_COMPILE"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CEPH_TPU_TEST_REEXEC"] = "1"
+    os.execvpe(sys.executable,
+               [sys.executable, "-m", "pytest", *sys.argv[1:]], env)

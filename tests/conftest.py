@@ -1,8 +1,9 @@
-"""Test harness config: force an 8-device virtual CPU mesh for JAX tests.
+"""Test harness config: hermetic 8-device virtual CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a host-platform device mesh exactly as the driver's
-dryrun_multichip does.
+dryrun_multichip does.  (force_cpu_plugin, loaded from pytest.ini, has
+already scrubbed any remote-TPU plugin env by re-exec'ing the run.)
 """
 
 import os
@@ -12,4 +13,3 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")

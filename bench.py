@@ -1,0 +1,111 @@
+"""Round benchmark: RS(k=8,m=3) erasure encode throughput on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Headline config (BASELINE.md): RS k=8 m=3, 1 MiB stripes, batch=1024,
+single chip, device-resident stripe batches (the deployment shape: stripes
+stream through HBM, thousands per launch).  Byte parity vs the host oracle
+is asserted before timing -- a number without parity is meaningless.
+
+vs_baseline is measured against this repo's native C++ AVX2 encoder
+(native/gf8.cc, the ISA-L-technique split-nibble SIMD path, single
+thread), the same role ISA-L plays in the reference's
+ceph_erasure_code_benchmark CPU runs.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    k, m = 8, 3
+    stripe = 1 << 20                    # 1 MiB stripe
+    chunk = stripe // k                 # 128 KiB per chunk
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+
+    from ceph_tpu.gf import gen_rs_matrix, gf_matmul
+    from ceph_tpu.native import gf8_matmul
+    from ceph_tpu.ec import registry
+
+    gen = gen_rs_matrix(k + m, k)
+    rng = np.random.default_rng(0)
+
+    codec = registry().factory("tpu", {"k": str(k), "m": str(m),
+                                       "technique": "reed_sol_van"})
+
+    # -- parity gate --------------------------------------------------------
+    sample = rng.integers(0, 256, size=(4, k, 4096), dtype=np.uint8)
+    got = np.asarray(codec.encode_batch(sample, out_np=True))
+    for b in range(4):
+        want = gf_matmul(gen[k:], sample[b])
+        if not np.array_equal(got[b], want):
+            print(json.dumps({"metric": "ec_encode_rs_k8m3",
+                              "value": 0.0, "unit": "GiB/s",
+                              "vs_baseline": 0.0,
+                              "error": "byte parity failure"}))
+            return 1
+
+    # -- TPU encode ---------------------------------------------------------
+    data = rng.integers(0, 256, size=(batch, k, chunk), dtype=np.uint8)
+    out = codec.encode_batch(data)          # device-resident result
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = codec.encode_batch(data)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    gibps = batch * k * chunk / dt / 2**30
+
+    # -- decode (2 erasures) -------------------------------------------------
+    erasures = [1, 9]
+    decode_index = [i for i in range(k + m) if i not in erasures][:k]
+    full = np.concatenate([data, np.zeros((batch, m, chunk), np.uint8)],
+                          axis=1)
+    full[:, k:] = np.asarray(out)
+    survivors = np.ascontiguousarray(full[:, decode_index])
+    rec = codec.decode_batch(erasures, survivors)
+    rec.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        rec = codec.decode_batch(erasures, survivors)
+    rec.block_until_ready()
+    dt_dec = (time.perf_counter() - t0) / iters
+    dec_gibps = batch * k * chunk / dt_dec / 2**30
+    if not np.array_equal(np.asarray(rec)[:, 0], full[:, erasures[0]]):
+        print(json.dumps({"metric": "ec_encode_rs_k8m3", "value": 0.0,
+                          "unit": "GiB/s", "vs_baseline": 0.0,
+                          "error": "decode parity failure"}))
+        return 1
+
+    # -- CPU baseline (native AVX2, single thread) ---------------------------
+    base_n = 1 << 22
+    base_data = rng.integers(0, 256, size=(k, base_n), dtype=np.uint8)
+    gf8_matmul(gen[k:], base_data)  # warm tables
+    t0 = time.perf_counter()
+    base_iters = 8
+    for _ in range(base_iters):
+        gf8_matmul(gen[k:], base_data)
+    base_dt = (time.perf_counter() - t0) / base_iters
+    base_gibps = k * base_n / base_dt / 2**30
+
+    combined = 2 / (1 / gibps + 1 / dec_gibps)  # harmonic: encode+decode
+    print(json.dumps({
+        "metric": "ec_rs_k8m3_encode_decode_GiBps_tpu_vs_cpu_avx2",
+        "value": round(combined, 2),
+        "unit": "GiB/s",
+        "vs_baseline": round(combined / base_gibps, 2),
+        "encode_GiBps": round(gibps, 2),
+        "decode_GiBps": round(dec_gibps, 2),
+        "cpu_baseline_GiBps": round(base_gibps, 2),
+        "batch": batch, "stripe_bytes": stripe,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""ctypes bindings for the native C++ runtime library.
+
+Builds lazily with make on first use if the .so is absent; every entry
+point has a numpy fallback so the framework stays functional without a
+toolchain.  The native GF path is also the CPU baseline the TPU kernels
+are measured against in bench.py (the ISA-L-technique stand-in).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libceph_tpu_native.so"
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+def _load():
+    global _lib, _build_attempted
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not _LIB_PATH.exists() and not _build_attempted:
+            _build_attempted = True
+            try:
+                subprocess.run(["make", "-C", str(_NATIVE_DIR), "-j4"],
+                               check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        if not _LIB_PATH.exists():
+            return None
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.gf8_matmul.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+        ]
+        lib.ceph_crc32c.restype = ctypes.c_uint32
+        lib.ceph_crc32c.argtypes = [
+            ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+        lib.rjenkins_hash3.restype = ctypes.c_uint32
+        lib.rjenkins_hash3.argtypes = [ctypes.c_uint32] * 3
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gf8_matmul(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """(r,k) GF(2^8) coeff matrix x (k,n) bytes -> (r,n), native path."""
+    lib = _load()
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    r, k = matrix.shape
+    n = data.shape[1]
+    if lib is None:
+        from .gf import gf_matmul
+        return gf_matmul(matrix, data)
+    out = np.empty((r, n), dtype=np.uint8)
+    lib.gf8_matmul(
+        matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), r, k,
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n)
+    return out
+
+
+def crc32c(data: bytes, crc: int = 0xFFFFFFFF) -> int:
+    """CRC32-C; default initial value matches the common -1 seed."""
+    lib = _load()
+    if lib is None:
+        return _crc32c_py(data, crc)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    if len(buf) == 0:
+        return crc
+    return int(lib.ceph_crc32c(
+        ctypes.c_uint32(crc),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf)))
+
+
+_CRC_TABLE = None
+
+
+def _crc32c_py(data: bytes, crc: int) -> int:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            tbl.append(c)
+        _CRC_TABLE = tbl
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc & 0xFFFFFFFF
+
+
+class NativeBackend:
+    """RSMatrixCodec backend over the C++ library (CPU baseline)."""
+
+    name = "native"
+
+    def matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        return gf8_matmul(matrix, data)

@@ -1,0 +1,14 @@
+"""Device-mesh parallelism: sharded erasure coding and bulk placement.
+
+The reference moves chunk shards between OSD processes over its
+AsyncMessenger (SURVEY.md section 2.8); on TPU the same dataflow is XLA
+collectives over ICI: stripe batches shard across a 'stripe' (data) axis,
+the k+m chunk shards map onto a 'shard' axis, and parity assembly is an
+all_gather/psum instead of a message fan-out.
+"""
+
+from .sharded_ec import (  # noqa: F401
+    make_mesh,
+    sharded_encode,
+    sharded_ec_step,
+)

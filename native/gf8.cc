@@ -1,0 +1,136 @@
+// GF(2^8) erasure-code math, host/C++ path.
+//
+// Serves two roles in the framework:
+//  1. the honest CPU baseline for bench.py (the stand-in for the
+//     reference's ISA-L ec_encode_data hot loop: split-nibble table
+//     lookups, AVX2 pshufb when available -- the same technique ISA-L's
+//     gf_vect_mul_avx uses);
+//  2. a host-side fallback codec for small ops where a TPU launch is not
+//     worth the round trip.
+//
+// Field: GF(2)[x]/(0x11d), identical to ceph_tpu/gf/gf8.py.
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr unsigned kPoly = 0x11d;
+
+struct Tables {
+  uint8_t mul[256][256];
+  // split tables: lo[c][x & 15], hi[c][x >> 4]
+  uint8_t lo[256][16];
+  uint8_t hi[256][16];
+  Tables() {
+    uint8_t exp[512];
+    int log[256] = {0};
+    unsigned v = 1;
+    for (int i = 0; i < 255; i++) {
+      exp[i] = static_cast<uint8_t>(v);
+      log[v] = i;
+      v <<= 1;
+      if (v & 0x100) v ^= kPoly;
+    }
+    for (int i = 255; i < 512; i++) exp[i] = exp[i - 255];
+    for (int a = 0; a < 256; a++) {
+      for (int b = 0; b < 256; b++) {
+        mul[a][b] = (a && b) ? exp[log[a] + log[b]] : 0;
+      }
+    }
+    for (int c = 0; c < 256; c++) {
+      for (int x = 0; x < 16; x++) {
+        lo[c][x] = mul[c][x];
+        hi[c][x] = mul[c][x << 4];
+      }
+    }
+  }
+};
+
+const Tables& tables() {
+  static Tables t;
+  return t;
+}
+
+void mul_acc_scalar(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
+  const uint8_t* row = tables().mul[c];
+  for (size_t i = 0; i < n; i++) dst[i] ^= row[src[i]];
+}
+
+#if defined(__x86_64__)
+__attribute__((target("avx2")))
+void mul_acc_avx2(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
+  const Tables& t = tables();
+  __m256i lo = _mm256_broadcastsi128_si256(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(t.lo[c])));
+  __m256i hi = _mm256_broadcastsi128_si256(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(t.hi[c])));
+  __m256i mask = _mm256_set1_epi8(0x0f);
+  size_t i = 0;
+  for (; i + 32 <= n; i += 32) {
+    __m256i x = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(src + i));
+    __m256i xl = _mm256_and_si256(x, mask);
+    __m256i xh = _mm256_and_si256(_mm256_srli_epi64(x, 4), mask);
+    __m256i p = _mm256_xor_si256(_mm256_shuffle_epi8(lo, xl),
+                                 _mm256_shuffle_epi8(hi, xh));
+    __m256i d = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(dst + i));
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + i),
+                        _mm256_xor_si256(d, p));
+  }
+  if (i < n) mul_acc_scalar(c, src + i, dst + i, n - i);
+}
+#endif
+
+void mul_acc(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
+#if defined(__x86_64__)
+  static const bool have_avx2 = __builtin_cpu_supports("avx2");
+  if (have_avx2) {
+    mul_acc_avx2(c, src, dst, n);
+    return;
+  }
+#endif
+  mul_acc_scalar(c, src, dst, n);
+}
+
+void xor_acc(const uint8_t* src, uint8_t* dst, size_t n) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t a, b;
+    std::memcpy(&a, dst + i, 8);
+    std::memcpy(&b, src + i, 8);
+    a ^= b;
+    std::memcpy(dst + i, &a, 8);
+  }
+  for (; i < n; i++) dst[i] ^= src[i];
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[r*n..] = XOR_j matrix[r*k+j] * data[j*n..]   (r rows, k sources)
+void gf8_matmul(const uint8_t* matrix, int rows, int k,
+                const uint8_t* data, uint8_t* out, size_t n) {
+  for (int r = 0; r < rows; r++) {
+    uint8_t* dst = out + static_cast<size_t>(r) * n;
+    std::memset(dst, 0, n);
+    for (int j = 0; j < k; j++) {
+      uint8_t c = matrix[r * k + j];
+      if (c == 0) continue;
+      const uint8_t* src = data + static_cast<size_t>(j) * n;
+      if (c == 1) {
+        xor_acc(src, dst, n);
+      } else {
+        mul_acc(c, src, dst, n);
+      }
+    }
+  }
+}
+
+uint8_t gf8_mul_one(uint8_t a, uint8_t b) { return tables().mul[a][b]; }
+
+}  // extern "C"

@@ -1,0 +1,53 @@
+// Batched rjenkins1 hashing for the host placement path.
+// Same mix/seed semantics as ceph_tpu/crush/hashes.py.
+
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+constexpr uint32_t kSeed = 1315423911u;
+
+inline void mix(uint32_t& a, uint32_t& b, uint32_t& c) {
+  a -= b; a -= c; a ^= c >> 13;
+  b -= c; b -= a; b ^= a << 8;
+  c -= a; c -= b; c ^= b >> 13;
+  a -= b; a -= c; a ^= c >> 12;
+  b -= c; b -= a; b ^= a << 16;
+  c -= a; c -= b; c ^= b >> 5;
+  a -= b; a -= c; a ^= c >> 3;
+  b -= c; b -= a; b ^= a << 10;
+  c -= a; c -= b; c ^= b >> 15;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t rjenkins_hash2(uint32_t a, uint32_t b) {
+  uint32_t h = kSeed ^ a ^ b;
+  uint32_t x = 231232, y = 1232;
+  mix(a, b, h);
+  mix(x, a, h);
+  mix(b, y, h);
+  return h;
+}
+
+uint32_t rjenkins_hash3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t h = kSeed ^ a ^ b ^ c;
+  uint32_t x = 231232, y = 1232;
+  mix(a, b, h);
+  mix(c, x, h);
+  mix(y, a, h);
+  mix(b, x, h);
+  mix(y, c, h);
+  return h;
+}
+
+// vectorized: out[i] = hash3(a[i], b[i], c[i])
+void rjenkins_hash3_batch(const uint32_t* a, const uint32_t* b,
+                          const uint32_t* c, uint32_t* out, size_t n) {
+  for (size_t i = 0; i < n; i++) out[i] = rjenkins_hash3(a[i], b[i], c[i]);
+}
+
+}  // extern "C"

@@ -1,0 +1,14 @@
+"""Object stores: transactional local storage under PGs.
+
+API rendering of the reference's ObjectStore contract
+(src/os/ObjectStore.h:63: queue_transactions :239, read :484, omap :708):
+collections (one per PG) of objects, each with byte data, xattrs, and an
+omap; all mutations batched in atomic Transactions.
+
+Backends: MemStore (RAM, tests/dev -- the reference has src/os/memstore);
+DBStore (SQLite WAL -- the RocksDB-backed BlueStore role: atomic commit
+via the WAL journal, data+metadata+omap in one transactional store).
+"""
+
+from .transaction import Transaction  # noqa: F401
+from .store import ObjectStore, MemStore, DBStore  # noqa: F401

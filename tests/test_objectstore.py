@@ -1,0 +1,107 @@
+import pytest
+
+from ceph_tpu.os import Transaction, MemStore, DBStore
+
+
+@pytest.fixture(params=["mem", "db"])
+def store(request, tmp_path):
+    if request.param == "mem":
+        return MemStore()
+    return DBStore(str(tmp_path / "osd.db"))
+
+
+def test_write_read_roundtrip(store):
+    t = Transaction()
+    t.create_collection("pg1")
+    t.write("pg1", "obj", 0, b"hello world")
+    store.queue_transaction(t)
+    assert store.read("pg1", "obj") == b"hello world"
+    assert store.stat("pg1", "obj")["size"] == 11
+
+
+def test_write_offset_extends_with_zeros(store):
+    t = Transaction().create_collection("c")
+    t.write("c", "o", 5, b"abc")
+    store.queue_transaction(t)
+    assert store.read("c", "o") == b"\x00" * 5 + b"abc"
+
+
+def test_partial_read(store):
+    store.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", 0, b"0123456789"))
+    assert store.read("c", "o", 2, 4) == b"2345"
+
+
+def test_zero_and_truncate(store):
+    store.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", 0, b"X" * 10)
+        .zero("c", "o", 2, 3).truncate("c", "o", 8))
+    assert store.read("c", "o") == b"XX\x00\x00\x00XXX"
+
+
+def test_remove_and_exists(store):
+    store.queue_transaction(
+        Transaction().create_collection("c").touch("c", "o"))
+    assert store.exists("c", "o")
+    store.queue_transaction(Transaction().remove("c", "o"))
+    assert not store.exists("c", "o")
+    with pytest.raises(FileNotFoundError):
+        store.read("c", "o")
+
+
+def test_xattrs(store):
+    store.queue_transaction(
+        Transaction().create_collection("c").touch("c", "o")
+        .setattr("c", "o", "version", b"1.2").setattr("c", "o", "x", b"y"))
+    assert store.getattr("c", "o", "version") == b"1.2"
+    assert store.getattrs("c", "o") == {"version": b"1.2", "x": b"y"}
+    store.queue_transaction(Transaction().rmattr("c", "o", "x"))
+    assert store.getattrs("c", "o") == {"version": b"1.2"}
+
+
+def test_omap(store):
+    store.queue_transaction(
+        Transaction().create_collection("c").touch("c", "o")
+        .omap_setkeys("c", "o", {"a": b"1", "b": b"2", "z": b"26"}))
+    assert store.omap_get("c", "o") == {"a": b"1", "b": b"2", "z": b"26"}
+    store.queue_transaction(Transaction().omap_rmkeys("c", "o", ["b"]))
+    assert store.omap_get_keys("c", "o", ["a", "b"]) == {"a": b"1"}
+    store.queue_transaction(Transaction().omap_clear("c", "o"))
+    assert store.omap_get("c", "o") == {}
+
+
+def test_clone(store):
+    store.queue_transaction(
+        Transaction().create_collection("c").write("c", "src", 0, b"data")
+        .setattr("c", "src", "a", b"v")
+        .omap_setkeys("c", "src", {"k": b"v"}))
+    store.queue_transaction(Transaction().clone("c", "src", "dst"))
+    assert store.read("c", "dst") == b"data"
+    assert store.getattr("c", "dst", "a") == b"v"
+    assert store.omap_get("c", "dst") == {"k": b"v"}
+    # clone is a snapshot: mutating src doesn't touch dst
+    store.queue_transaction(Transaction().write("c", "src", 0, b"DATA"))
+    assert store.read("c", "dst") == b"data"
+
+
+def test_missing_collection_rejected(store):
+    with pytest.raises(KeyError):
+        store.queue_transaction(Transaction().write("nope", "o", 0, b"x"))
+
+
+def test_collections_listing(store):
+    store.queue_transaction(Transaction().create_collection("pg2"))
+    store.queue_transaction(Transaction().create_collection("pg1"))
+    assert store.list_collections() == ["pg1", "pg2"]
+    store.queue_transaction(
+        Transaction().touch("pg1", "b").touch("pg1", "a"))
+    assert store.list_objects("pg1") == ["a", "b"]
+
+
+def test_dbstore_persistence(tmp_path):
+    path = str(tmp_path / "osd.db")
+    s1 = DBStore(path)
+    s1.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", 0, b"persist"))
+    s2 = DBStore(path)
+    assert s2.read("c", "o") == b"persist"

@@ -1,0 +1,12 @@
+"""Async messenger: the host-side control/data plane transport.
+
+The TPU-native split (SURVEY.md section 2.8): bulk chunk movement rides
+XLA collectives over ICI inside a mesh; everything the reference sends as
+messenger RPCs between daemons (maps, peering, heartbeats, rep/EC sub-ops
+across failure domains) rides this asyncio messenger with v2-lite frames
+(length-prefixed, crc32c-checksummed, HMAC-authenticated session setup --
+the ProtocolV2 crc-mode analog, src/msg/async/ProtocolV2.h:19-56).
+"""
+
+from .message import Message  # noqa: F401
+from .messenger import Messenger, Connection  # noqa: F401

@@ -1,0 +1,79 @@
+"""Wire message model and v2-lite frame codec.
+
+Frame = magic | u32 meta_len | meta(json) | segments | u32 crc32c, where
+meta carries {t, seq, from, data, seg_lens}.  JSON meta + raw binary
+segments keeps control fields debuggable while bulk chunk bytes stay
+zero-copy -- the same meta/payload segment split ProtocolV2 frames use
+(4 segments + epilogue crcs, src/msg/async/frames_v2.cc).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..native import crc32c
+
+MAGIC = b"CTv2"
+MAX_FRAME = 256 << 20
+
+
+@dataclass
+class Message:
+    type: str
+    data: dict[str, Any] = field(default_factory=dict)
+    segments: list[bytes] = field(default_factory=list)
+    seq: int = 0
+    from_name: str = ""
+
+    def encode(self) -> bytes:
+        meta = {
+            "t": self.type,
+            "seq": self.seq,
+            "from": self.from_name,
+            "data": self.data,
+            "segs": [len(s) for s in self.segments],
+        }
+        mb = json.dumps(meta, separators=(",", ":")).encode()
+        body = mb + b"".join(self.segments)
+        crc = crc32c(body) & 0xFFFFFFFF
+        return MAGIC + struct.pack("<I", len(mb)) + body + struct.pack(
+            "<I", crc)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Message":
+        if buf[:4] != MAGIC:
+            raise ValueError("bad magic")
+        (meta_len,) = struct.unpack_from("<I", buf, 4)
+        mb = buf[8:8 + meta_len]
+        meta = json.loads(mb)
+        (crc,) = struct.unpack_from("<I", buf, len(buf) - 4)
+        body = buf[8:len(buf) - 4]
+        if (crc32c(body) & 0xFFFFFFFF) != crc:
+            raise ValueError("frame crc mismatch")
+        segments = []
+        off = 8 + meta_len
+        for ln in meta["segs"]:
+            segments.append(buf[off:off + ln])
+            off += ln
+        return cls(type=meta["t"], data=meta["data"], segments=segments,
+                   seq=meta["seq"], from_name=meta["from"])
+
+
+async def read_frame(reader) -> bytes:
+    """Read one full frame from an asyncio StreamReader."""
+    hdr = await reader.readexactly(8)
+    if hdr[:4] != MAGIC:
+        raise ValueError("bad magic")
+    (meta_len,) = struct.unpack_from("<I", hdr, 4)
+    if meta_len > MAX_FRAME:
+        raise ValueError("oversized meta")
+    mb = await reader.readexactly(meta_len)
+    meta = json.loads(mb)
+    total_segs = sum(meta["segs"])
+    if total_segs > MAX_FRAME:
+        raise ValueError("oversized frame")
+    rest = await reader.readexactly(total_segs + 4)
+    return hdr + mb + rest

@@ -1,0 +1,220 @@
+"""Asyncio messenger with lossless-client reconnect semantics.
+
+Responsibilities mirrored from the reference's AsyncMessenger
+(src/msg/async/AsyncMessenger.h:74): bind/accept, connect-by-address with
+connection caching, ordered per-connection delivery with sequence numbers,
+resend of unacked messages after reconnect (lossless policy,
+src/msg/Policy.h), dispatcher fan-out, and an HMAC-SHA256 session
+handshake standing in for cephx (src/auth/cephx) in crc mode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import json
+import os
+import struct
+from collections import deque
+from typing import Awaitable, Callable
+
+from .message import Message, read_frame
+
+Dispatcher = Callable[["Connection", Message], Awaitable[None]]
+
+HELLO_MAGIC = b"CTHL"
+
+
+class Connection:
+    def __init__(self, messenger: "Messenger", peer_name: str,
+                 reader, writer, *, outgoing: bool,
+                 peer_addr: tuple[str, int] | None = None) -> None:
+        self.messenger = messenger
+        self.peer_name = peer_name
+        self.reader = reader
+        self.writer = writer
+        self.outgoing = outgoing
+        self.peer_addr = peer_addr
+        self.out_seq = 0
+        self.in_seq = 0
+        self.unacked: deque[Message] = deque()
+        self.closed = False
+        self._send_lock = asyncio.Lock()
+        self._read_task: asyncio.Task | None = None
+
+    async def send(self, msg: Message) -> None:
+        async with self._send_lock:
+            self.out_seq += 1
+            msg.seq = self.out_seq
+            msg.from_name = self.messenger.name
+            self.unacked.append(msg)
+            if len(self.unacked) > 1024:
+                self.unacked.popleft()
+            try:
+                self.writer.write(msg.encode())
+                await self.writer.drain()
+            except (ConnectionError, OSError):
+                if self.outgoing:
+                    await self.messenger._reconnect(self)
+                else:
+                    await self.close()
+                    raise
+
+    async def _resend_unacked(self) -> None:
+        for msg in list(self.unacked):
+            self.writer.write(msg.encode())
+        await self.writer.drain()
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._read_task:
+            self._read_task.cancel()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class Messenger:
+    def __init__(self, name: str, secret: bytes | None = None) -> None:
+        self.name = name
+        self.secret = secret
+        self.dispatchers: list[Dispatcher] = []
+        self.conns: dict[str, Connection] = {}       # by peer name
+        # per-peer last delivered seq; survives reconnects so replayed
+        # messages dedup (the lossless policy's session state)
+        self._sessions: dict[str, int] = {}
+        self._server: asyncio.base_events.Server | None = None
+        self.addr: tuple[str, int] | None = None
+        self._accept_tasks: set[asyncio.Task] = set()
+
+    # -- server -------------------------------------------------------------
+    async def bind(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._on_accept, host, port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        return self.addr
+
+    def add_dispatcher(self, fn: Dispatcher) -> None:
+        self.dispatchers.append(fn)
+
+    async def _on_accept(self, reader, writer) -> None:
+        try:
+            peer_name = await self._handshake_server(reader, writer)
+        except (asyncio.IncompleteReadError, ValueError, ConnectionError):
+            writer.close()
+            return
+        conn = Connection(self, peer_name, reader, writer, outgoing=False)
+        conn.in_seq = self._sessions.get(peer_name, 0)
+        old = self.conns.get(peer_name)
+        if old is not None and not old.outgoing:
+            await old.close()
+        self.conns[peer_name] = conn
+        conn._read_task = asyncio.ensure_future(self._read_loop(conn))
+
+    # -- handshake (HMAC challenge, cephx-lite) ------------------------------
+    async def _handshake_server(self, reader, writer) -> str:
+        nonce = os.urandom(16)
+        writer.write(HELLO_MAGIC + struct.pack("<16s", nonce))
+        await writer.drain()
+        hdr = await reader.readexactly(4)
+        if hdr != HELLO_MAGIC:
+            raise ValueError("bad hello")
+        (nlen,) = struct.unpack("<I", await reader.readexactly(4))
+        payload = json.loads(await reader.readexactly(nlen))
+        proof = bytes.fromhex(payload.get("proof", ""))
+        if self.secret is not None:
+            want = hmac.new(self.secret, nonce, hashlib.sha256).digest()
+            if not hmac.compare_digest(proof, want):
+                writer.write(b"NACK")
+                await writer.drain()
+                raise ValueError("auth failure")
+        last_seq = self._sessions.get(payload["name"], 0)
+        writer.write(b"ACK!" + struct.pack("<Q", last_seq))
+        await writer.drain()
+        return payload["name"]
+
+    async def _handshake_client(self, reader, writer) -> None:
+        hdr = await reader.readexactly(20)
+        if hdr[:4] != HELLO_MAGIC:
+            raise ValueError("bad hello")
+        nonce = hdr[4:20]
+        proof = b""
+        if self.secret is not None:
+            proof = hmac.new(self.secret, nonce, hashlib.sha256).digest()
+        payload = json.dumps({"name": self.name,
+                              "proof": proof.hex()}).encode()
+        writer.write(HELLO_MAGIC + struct.pack("<I", len(payload)) + payload)
+        await writer.drain()
+        ack = await reader.readexactly(4)
+        if ack != b"ACK!":
+            raise ConnectionError("auth rejected")
+        (last_seq,) = struct.unpack("<Q", await reader.readexactly(8))
+        return last_seq
+
+    # -- client -------------------------------------------------------------
+    async def connect(self, addr: tuple[str, int],
+                      peer_name: str) -> Connection:
+        conn = self.conns.get(peer_name)
+        if conn is not None and not conn.closed:
+            return conn
+        reader, writer = await asyncio.open_connection(addr[0], addr[1])
+        await self._handshake_client(reader, writer)
+        conn = Connection(self, peer_name, reader, writer, outgoing=True,
+                          peer_addr=addr)
+        self.conns[peer_name] = conn
+        conn._read_task = asyncio.ensure_future(self._read_loop(conn))
+        return conn
+
+    async def _reconnect(self, conn: Connection) -> None:
+        """Lossless policy: reopen and replay unacked in order."""
+        if conn.peer_addr is None:
+            await conn.close()
+            raise ConnectionError("incoming connection lost")
+        for attempt in range(5):
+            try:
+                reader, writer = await asyncio.open_connection(
+                    conn.peer_addr[0], conn.peer_addr[1])
+                last_seq = await self._handshake_client(reader, writer)
+                while conn.unacked and conn.unacked[0].seq <= last_seq:
+                    conn.unacked.popleft()
+                conn.reader, conn.writer = reader, writer
+                if conn._read_task:
+                    conn._read_task.cancel()
+                conn._read_task = asyncio.ensure_future(self._read_loop(conn))
+                await conn._resend_unacked()
+                return
+            except (ConnectionError, OSError):
+                await asyncio.sleep(0.05 * (2 ** attempt))
+        await conn.close()
+        raise ConnectionError(f"reconnect to {conn.peer_name} failed")
+
+    async def send(self, addr: tuple[str, int], peer_name: str,
+                   msg: Message) -> None:
+        conn = await self.connect(addr, peer_name)
+        await conn.send(msg)
+
+    # -- dispatch -----------------------------------------------------------
+    async def _read_loop(self, conn: Connection) -> None:
+        try:
+            while not conn.closed:
+                buf = await read_frame(conn.reader)
+                msg = Message.decode(buf)
+                if msg.seq <= conn.in_seq:
+                    continue  # duplicate after resend
+                conn.in_seq = msg.seq
+                if not conn.outgoing:
+                    self._sessions[conn.peer_name] = msg.seq
+                for d in self.dispatchers:
+                    await d(conn, msg)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError, ValueError):
+            pass
+
+    async def shutdown(self) -> None:
+        for conn in list(self.conns.values()):
+            await conn.close()
+        self.conns.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()

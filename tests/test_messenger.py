@@ -1,0 +1,163 @@
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import Message, Messenger
+from ceph_tpu.msg.message import read_frame
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+def test_message_codec_roundtrip():
+    m = Message("osd_op", {"op": "write", "oid": "foo"},
+                segments=[b"payload", b"\x00bin\xff"])
+    m.seq = 7
+    m.from_name = "client.1"
+    buf = m.encode()
+    m2 = Message.decode(buf)
+    assert m2.type == "osd_op"
+    assert m2.data == {"op": "write", "oid": "foo"}
+    assert m2.segments == [b"payload", b"\x00bin\xff"]
+    assert m2.seq == 7 and m2.from_name == "client.1"
+
+
+def test_message_crc_detects_corruption():
+    buf = bytearray(Message("x", {"a": 1}, [b"data"]).encode())
+    buf[-6] ^= 0xFF  # flip a payload byte
+    with pytest.raises(ValueError):
+        Message.decode(bytes(buf))
+
+
+def test_basic_send_dispatch():
+    async def main():
+        server = Messenger("osd.0")
+        client = Messenger("client.a")
+        got = []
+        done = asyncio.Event()
+
+        async def dispatch(conn, msg):
+            got.append(msg)
+            done.set()
+
+        server.add_dispatcher(dispatch)
+        addr = await server.bind()
+        await client.send(addr, "osd.0", Message("ping", {"n": 1}, [b"hi"]))
+        await asyncio.wait_for(done.wait(), 5)
+        await client.shutdown()
+        await server.shutdown()
+        return got
+
+    got = run(main())
+    assert got[0].type == "ping"
+    assert got[0].from_name == "client.a"
+    assert got[0].segments == [b"hi"]
+
+
+def test_bidirectional_reply():
+    async def main():
+        server = Messenger("mon.0")
+        client = Messenger("client.b")
+        reply = asyncio.Event()
+        replies = []
+
+        async def server_dispatch(conn, msg):
+            await conn.send(Message("pong", {"echo": msg.data["n"]}))
+
+        async def client_dispatch(conn, msg):
+            replies.append(msg)
+            reply.set()
+
+        server.add_dispatcher(server_dispatch)
+        client.add_dispatcher(client_dispatch)
+        addr = await server.bind()
+        await client.send(addr, "mon.0", Message("ping", {"n": 42}))
+        await asyncio.wait_for(reply.wait(), 5)
+        await client.shutdown()
+        await server.shutdown()
+        return replies
+
+    replies = run(main())
+    assert replies[0].type == "pong"
+    assert replies[0].data["echo"] == 42
+
+
+def test_auth_secret_rejects_wrong_key():
+    async def main():
+        server = Messenger("mon.0", secret=b"sekret")
+        good = Messenger("client.good", secret=b"sekret")
+        bad = Messenger("client.bad", secret=b"wrong")
+        seen = []
+
+        async def dispatch(conn, msg):
+            seen.append(msg.from_name)
+
+        server.add_dispatcher(dispatch)
+        addr = await server.bind()
+        await good.send(addr, "mon.0", Message("hello"))
+        with pytest.raises((ConnectionError, OSError)):
+            await bad.send(addr, "mon.0", Message("hello"))
+        await asyncio.sleep(0.1)
+        await good.shutdown()
+        await bad.shutdown()
+        await server.shutdown()
+        return seen
+
+    seen = run(main())
+    assert seen == ["client.good"]
+
+
+def test_ordered_delivery_many():
+    async def main():
+        server = Messenger("osd.1")
+        client = Messenger("client.c")
+        got = []
+        done = asyncio.Event()
+
+        async def dispatch(conn, msg):
+            got.append(msg.data["i"])
+            if len(got) == 100:
+                done.set()
+
+        server.add_dispatcher(dispatch)
+        addr = await server.bind()
+        conn = await client.connect(addr, "osd.1")
+        for i in range(100):
+            await conn.send(Message("n", {"i": i}))
+        await asyncio.wait_for(done.wait(), 10)
+        await client.shutdown()
+        await server.shutdown()
+        return got
+
+    got = run(main())
+    assert got == list(range(100))
+
+
+def test_reconnect_resends_unacked():
+    async def main():
+        server = Messenger("osd.2")
+        client = Messenger("client.d")
+        got = []
+
+        async def dispatch(conn, msg):
+            got.append(msg.data["i"])
+
+        server.add_dispatcher(dispatch)
+        addr = await server.bind()
+        conn = await client.connect(addr, "osd.2")
+        await conn.send(Message("n", {"i": 0}))
+        await asyncio.sleep(0.1)
+        # sever the TCP connection under the client
+        conn.writer.close()
+        await asyncio.sleep(0.05)
+        await conn.send(Message("n", {"i": 1}))
+        await asyncio.sleep(0.2)
+        await client.shutdown()
+        await server.shutdown()
+        return got
+
+    got = run(main())
+    # resend after reconnect may duplicate already-seen seqs; the receiver
+    # dedups, so the result is exactly [0, 1]
+    assert got == [0, 1]

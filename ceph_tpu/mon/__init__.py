@@ -1,0 +1,11 @@
+"""Cluster control plane: monitors own the maps.
+
+Monitors hold the authoritative OSDMap (epochs + incrementals), pool and
+EC-profile tables, and the CRUSH map; changes commit through a
+paxos-lite replicated log and publish to subscribers (the
+Paxos/PaxosService/OSDMonitor stack of src/mon, rendered as asyncio
+services over the v2-lite messenger).
+"""
+
+from .osdmap import OSDMap, PoolSpec, Incremental  # noqa: F401
+from .monitor import Monitor  # noqa: F401

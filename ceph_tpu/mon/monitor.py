@@ -1,0 +1,479 @@
+"""Monitor daemon: map authority, paxos-lite replication, failure handling.
+
+Functional rendering of the src/mon stack: a replicated commit log with
+collect/begin/accept/commit phases and a leader lease (Paxos.cc:154-1530),
+map services that batch pending changes and propose them
+(PaxosService.cc:196), and the OSDMonitor behaviors the data path needs:
+osd boot -> up, failure reports with a min-reporter threshold
+(mon_osd_min_down_reporters), down->out aging, pool and EC-profile
+commands, CRUSH rule creation at pool create (OSDMonitor.cc:7484-7566).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import time
+from collections import defaultdict
+
+from ..msg import Message, Messenger
+from ..crush.types import (
+    Bucket, CrushMap, CRUSH_BUCKET_STRAW2,
+)
+from ..crush.builder import replicated_rule, erasure_rule
+from ..ec import registry as ec_registry
+from .osdmap import (
+    OSDMap, Incremental, PoolSpec, crush_to_dict,
+    POOL_TYPE_REPLICATED, POOL_TYPE_ERASURE,
+)
+
+DEFAULT_EC_PROFILE = {"plugin": "tpu", "k": "2", "m": "1",
+                      "technique": "reed_sol_van"}
+
+
+class MonStore:
+    """Versioned commit log + stashed full maps (MonitorDBStore analog)."""
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self.conn = sqlite3.connect(path)
+        with self.conn:
+            self.conn.execute(
+                "CREATE TABLE IF NOT EXISTS log ("
+                "version INTEGER PRIMARY KEY, value BLOB)")
+            self.conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (key TEXT PRIMARY KEY, "
+                "value BLOB)")
+
+    def last_committed(self) -> int:
+        row = self.conn.execute("SELECT MAX(version) FROM log").fetchone()
+        return row[0] or 0
+
+    def commit(self, version: int, value: bytes) -> None:
+        with self.conn:
+            self.conn.execute("INSERT OR REPLACE INTO log VALUES (?,?)",
+                              (version, value))
+
+    def get(self, version: int) -> bytes | None:
+        row = self.conn.execute("SELECT value FROM log WHERE version=?",
+                                (version,)).fetchone()
+        return None if row is None else row[0]
+
+    def put_kv(self, key: str, value: bytes) -> None:
+        with self.conn:
+            self.conn.execute("INSERT OR REPLACE INTO kv VALUES (?,?)",
+                              (key, value))
+
+    def get_kv(self, key: str) -> bytes | None:
+        row = self.conn.execute("SELECT value FROM kv WHERE key=?",
+                                (key,)).fetchone()
+        return None if row is None else row[0]
+
+
+class Monitor:
+    def __init__(self, rank: int = 0, peers: list[tuple[str, int]] | None = None,
+                 store_path: str = ":memory:", secret: bytes | None = None,
+                 config: dict | None = None) -> None:
+        self.rank = rank
+        self.peer_addrs = peers or []     # rank -> addr (incl. self slot)
+        self.msgr = Messenger(f"mon.{rank}", secret=secret)
+        self.store = MonStore(store_path)
+        self.osdmap = OSDMap()
+        self.config = {
+            "mon_osd_min_down_reporters": 2,
+            "mon_osd_down_out_interval": 600.0,
+            "mon_lease": 5.0,
+            **(config or {}),
+        }
+        self.incrementals: dict[int, Incremental] = {}
+        self.subscribers: dict[str, object] = {}   # peer name -> Connection
+        self.failure_reports: dict[int, set[str]] = defaultdict(set)
+        self.osd_hosts: dict[int, str] = {}
+        self.osd_uuids: dict[str, int] = {}
+        self._pending_lock = asyncio.Lock()
+        self._tick_task: asyncio.Task | None = None
+        self._down_since: dict[int, float] = {}
+        # paxos-lite
+        self.quorum: set[int] = {rank}
+        self.accepts: dict[int, set[int]] = {}
+        self._commit_waiters: dict[int, asyncio.Future] = {}
+        self.msgr.add_dispatcher(self._dispatch)
+        self._replay()
+
+    # -- lifecycle ----------------------------------------------------------
+    def _replay(self) -> None:
+        last = self.store.last_committed()
+        for v in range(1, last + 1):
+            blob = self.store.get(v)
+            if blob:
+                inc = Incremental.from_dict(json.loads(blob))
+                self.osdmap.apply_incremental(inc)
+                self.incrementals[inc.epoch] = inc
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        addr = await self.msgr.bind(host, port)
+        while len(self.peer_addrs) <= self.rank:
+            self.peer_addrs.append(None)
+        self.peer_addrs[self.rank] = addr
+        self._tick_task = asyncio.ensure_future(self._tick_loop())
+        return addr
+
+    async def stop(self) -> None:
+        if self._tick_task:
+            self._tick_task.cancel()
+        await self.msgr.shutdown()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.rank == min(self.quorum)
+
+    def _majority(self) -> int:
+        return len([a for a in self.peer_addrs if a is not None]) // 2 + 1
+
+    # -- proposal path ------------------------------------------------------
+    async def propose(self, inc: Incremental) -> None:
+        """Commit one incremental through the quorum (leader-side)."""
+        async with self._pending_lock:
+            inc.epoch = self.osdmap.epoch + 1
+            blob = json.dumps(inc.to_dict()).encode()
+            version = inc.epoch
+            n_peers = len([a for a in self.peer_addrs if a is not None])
+            if n_peers <= 1:
+                self._commit_local(version, blob)
+            else:
+                self.accepts[version] = {self.rank}
+                fut = asyncio.get_event_loop().create_future()
+                self._commit_waiters[version] = fut
+                for r, addr in enumerate(self.peer_addrs):
+                    if r == self.rank or addr is None:
+                        continue
+                    try:
+                        await self.msgr.send(
+                            tuple(addr), f"mon.{r}",
+                            Message("paxos_begin",
+                                    {"version": version,
+                                     "value": blob.decode()}))
+                    except (ConnectionError, OSError):
+                        pass
+                await asyncio.wait_for(fut, timeout=10)
+                self._commit_local(version, blob)
+            await self._publish(inc)
+
+    def _commit_local(self, version: int, blob: bytes) -> None:
+        self.store.commit(version, blob)
+        inc = Incremental.from_dict(json.loads(blob))
+        self.osdmap.apply_incremental(inc)
+        self.incrementals[inc.epoch] = inc
+
+    async def _publish(self, inc: Incremental) -> None:
+        # distribute commit to peons + map delta to subscribers
+        n_peers = len([a for a in self.peer_addrs if a is not None])
+        if n_peers > 1:
+            for r, addr in enumerate(self.peer_addrs):
+                if r == self.rank or addr is None:
+                    continue
+                try:
+                    await self.msgr.send(
+                        tuple(addr), f"mon.{r}",
+                        Message("paxos_commit", {"version": inc.epoch}))
+                except (ConnectionError, OSError):
+                    pass
+        dead = []
+        for name, conn in self.subscribers.items():
+            try:
+                await conn.send(Message("osdmap_inc",
+                                        {"inc": inc.to_dict()}))
+            except (ConnectionError, OSError):
+                dead.append(name)
+        for name in dead:
+            self.subscribers.pop(name, None)
+
+    # -- dispatch -----------------------------------------------------------
+    async def _dispatch(self, conn, msg: Message) -> None:
+        handler = getattr(self, f"_h_{msg.type}", None)
+        if handler is not None:
+            await handler(conn, msg)
+
+    async def _h_paxos_begin(self, conn, msg) -> None:
+        version = msg.data["version"]
+        blob = msg.data["value"].encode()
+        # peon: accept if it extends our log
+        if version == self.store.last_committed() + 1:
+            self.store.put_kv(f"pending_{version}", blob)
+            await conn.send(Message("paxos_accept", {"version": version,
+                                                     "rank": self.rank}))
+
+    async def _h_paxos_accept(self, conn, msg) -> None:
+        version = msg.data["version"]
+        acc = self.accepts.get(version)
+        if acc is None:
+            return
+        acc.add(msg.data["rank"])
+        if len(acc) >= self._majority():
+            fut = self._commit_waiters.pop(version, None)
+            if fut and not fut.done():
+                fut.set_result(True)
+
+    async def _h_paxos_commit(self, conn, msg) -> None:
+        version = msg.data["version"]
+        blob = self.store.get_kv(f"pending_{version}")
+        if blob is not None and version == self.store.last_committed() + 1:
+            self._commit_local(version, blob)
+
+    async def _h_mon_probe(self, conn, msg) -> None:
+        self.quorum.add(msg.data["rank"])
+        await conn.send(Message("mon_probe_ack", {"rank": self.rank}))
+
+    async def _h_mon_probe_ack(self, conn, msg) -> None:
+        self.quorum.add(msg.data["rank"])
+
+    # -- osd lifecycle ------------------------------------------------------
+    async def _h_osd_boot(self, conn, msg) -> None:
+        """OSD announces itself: {uuid, addr, host, osd_id?}."""
+        uuid = msg.data["uuid"]
+        host = msg.data.get("host", "host0")
+        addr = msg.data["addr"]
+        osd_id = msg.data.get("osd_id")
+        if osd_id is None:
+            osd_id = self.osd_uuids.get(uuid)
+        if osd_id is None:
+            osd_id = self.osdmap.max_osd
+        self.osd_uuids[uuid] = osd_id
+        self.osd_hosts[osd_id] = host
+        inc = Incremental(epoch=0)
+        inc.new_up[osd_id] = list(addr)
+        inc.new_in.append(osd_id)
+        inc.new_weights[osd_id] = 0x10000
+        inc.new_max_osd = max(self.osdmap.max_osd, osd_id + 1)
+        inc.new_crush = self._build_crush_dict(extra_osd=(osd_id, host))
+        await self.propose(inc)
+        await conn.send(Message("osd_boot_ack",
+                                {"osd_id": osd_id,
+                                 "epoch": self.osdmap.epoch}))
+
+    def _build_crush_dict(self, extra_osd=None) -> dict:
+        """Rebuild the CRUSH map from the osd->host registry.
+
+        Two-level straw2 hierarchy root->host->osd with rule 0 replicated
+        and rule 1 erasure (chooseleaf over hosts when >1 host, else
+        direct osd choose) -- the default map OSDMonitor builds as OSDs
+        register.
+        """
+        hosts: dict[str, list[int]] = defaultdict(list)
+        for osd, host in self.osd_hosts.items():
+            hosts[host].append(osd)
+        if extra_osd is not None:
+            osd, host = extra_osd
+            if osd not in hosts[host]:
+                hosts[host].append(osd)
+        cm = CrushMap()
+        host_ids = []
+        for i, hname in enumerate(sorted(hosts)):
+            hid = -(2 + i)
+            osds = sorted(hosts[hname])
+            cm.add_bucket(
+                Bucket(id=hid, type=1, alg=CRUSH_BUCKET_STRAW2, items=osds,
+                       item_weights=[0x10000] * len(osds)), hname)
+            host_ids.append(hid)
+        cm.add_bucket(
+            Bucket(id=-1, type=10, alg=CRUSH_BUCKET_STRAW2, items=host_ids,
+                   item_weights=[0x10000 * len(hosts[h])
+                                 for h in sorted(hosts)]), "default")
+        multi_host = len(host_ids) > 1
+        cm.add_rule(replicated_rule(0, -1, choose_type=1 if multi_host else 0,
+                                    leaf=multi_host))
+        cm.add_rule(erasure_rule(1, -1, choose_type=1 if multi_host else 0,
+                                 leaf=multi_host))
+        return crush_to_dict(cm)
+
+    async def _h_osd_failure(self, conn, msg) -> None:
+        """Failure report; mark down once enough distinct reporters agree."""
+        target = msg.data["target"]
+        reporter = msg.from_name
+        if not self.osdmap.is_up(target):
+            return
+        self.failure_reports[target].add(reporter)
+        n_up = sum(1 for o in self.osdmap.osds.values() if o.up)
+        need = min(self.config["mon_osd_min_down_reporters"],
+                   max(1, n_up - 1))
+        if len(self.failure_reports[target]) >= need:
+            inc = Incremental(epoch=0)
+            inc.new_down.append(target)
+            self.failure_reports.pop(target, None)
+            self._down_since[target] = time.monotonic()
+            await self.propose(inc)
+
+    async def _h_osd_alive(self, conn, msg) -> None:
+        osd = msg.data["osd_id"]
+        self.failure_reports.pop(osd, None)
+
+    # -- subscriptions ------------------------------------------------------
+    async def _h_sub_osdmap(self, conn, msg) -> None:
+        self.subscribers[msg.from_name] = conn
+        await conn.send(Message("osdmap_full",
+                                {"map": self.osdmap.to_dict()}))
+
+    async def _h_get_osdmap(self, conn, msg) -> None:
+        since = msg.data.get("since", 0)
+        incs = [self.incrementals[e].to_dict()
+                for e in range(since + 1, self.osdmap.epoch + 1)
+                if e in self.incrementals]
+        if len(incs) == self.osdmap.epoch - since:
+            await conn.send(Message("osdmap_incs", {"incs": incs}))
+        else:
+            await conn.send(Message("osdmap_full",
+                                    {"map": self.osdmap.to_dict()}))
+
+    # -- commands -----------------------------------------------------------
+    async def _h_mon_command(self, conn, msg) -> None:
+        cmd = msg.data.get("cmd", "")
+        args = msg.data.get("args", {})
+        try:
+            result = await self.handle_command(cmd, args)
+            await conn.send(Message("mon_command_reply",
+                                    {"ok": True, "result": result,
+                                     "tid": msg.data.get("tid")}))
+        except Exception as e:  # command errors return to caller
+            await conn.send(Message("mon_command_reply",
+                                    {"ok": False, "error": str(e),
+                                     "tid": msg.data.get("tid")}))
+
+    async def handle_command(self, cmd: str, args: dict):
+        if cmd == "osd pool create":
+            return await self._cmd_pool_create(args)
+        if cmd == "osd pool rm":
+            return await self._cmd_pool_rm(args)
+        if cmd == "osd pool ls":
+            return sorted(self.osdmap.pool_names)
+        if cmd == "osd erasure-code-profile set":
+            name = args["name"]
+            profile = dict(args.get("profile", {}))
+            # validate by instantiating the plugin
+            plugin = profile.get("plugin", "tpu")
+            ec_registry().factory(plugin, {k: v for k, v in profile.items()
+                                           if k != "plugin"})
+            inc = Incremental(epoch=0)
+            inc.new_ec_profiles[name] = profile
+            await self.propose(inc)
+            return name
+        if cmd == "osd erasure-code-profile ls":
+            return sorted(self.osdmap.ec_profiles)
+        if cmd == "osd erasure-code-profile get":
+            return self.osdmap.ec_profiles[args["name"]]
+        if cmd == "osd erasure-code-profile rm":
+            inc = Incremental(epoch=0)
+            inc.removed_ec_profiles.append(args["name"])
+            await self.propose(inc)
+            return args["name"]
+        if cmd == "osd out":
+            inc = Incremental(epoch=0)
+            inc.new_out.append(int(args["osd_id"]))
+            await self.propose(inc)
+            return int(args["osd_id"])
+        if cmd == "osd in":
+            inc = Incremental(epoch=0)
+            inc.new_in.append(int(args["osd_id"]))
+            await self.propose(inc)
+            return int(args["osd_id"])
+        if cmd == "osd reweight":
+            inc = Incremental(epoch=0)
+            inc.new_weights[int(args["osd_id"])] = int(args["weight"])
+            await self.propose(inc)
+            return True
+        if cmd == "osd dump":
+            return self.osdmap.to_dict()
+        if cmd == "osd tree":
+            return self._cmd_osd_tree()
+        if cmd == "status":
+            n_up = sum(1 for o in self.osdmap.osds.values() if o.up)
+            n_in = sum(1 for o in self.osdmap.osds.values() if o.in_cluster)
+            return {"epoch": self.osdmap.epoch,
+                    "num_osds": len(self.osdmap.osds),
+                    "num_up": n_up, "num_in": n_in,
+                    "pools": len(self.osdmap.pools),
+                    "health": "HEALTH_OK" if n_up == len(self.osdmap.osds)
+                              else "HEALTH_WARN"}
+        raise ValueError(f"unknown command: {cmd}")
+
+    async def _cmd_pool_create(self, args: dict):
+        name = args["name"]
+        if name in self.osdmap.pool_names:
+            return self.osdmap.pool_names[name]
+        pg_num = int(args.get("pg_num", 32))
+        pool_id = max(self.osdmap.pools, default=0) + 1
+        pool_type = args.get("type", "replicated")
+        inc = Incremental(epoch=0)
+        if pool_type == "erasure":
+            profile_name = args.get("erasure_code_profile", "default")
+            profile = self.osdmap.ec_profiles.get(profile_name)
+            if profile is None:
+                if profile_name != "default":
+                    raise ValueError(f"no EC profile {profile_name}")
+                profile = dict(DEFAULT_EC_PROFILE)
+                inc.new_ec_profiles["default"] = profile
+            k = int(profile.get("k", 2))
+            m = int(profile.get("m", 1))
+            spec = PoolSpec(pool_id=pool_id, name=name,
+                            type=POOL_TYPE_ERASURE, size=k + m,
+                            min_size=k + 1 if m > 1 else k,
+                            pg_num=pg_num, pgp_num=pg_num, crush_rule=1,
+                            erasure_code_profile=profile_name)
+        else:
+            spec = PoolSpec(pool_id=pool_id, name=name,
+                            type=POOL_TYPE_REPLICATED,
+                            size=int(args.get("size", 3)),
+                            min_size=int(args.get("min_size", 2)),
+                            pg_num=pg_num, pgp_num=pg_num, crush_rule=0)
+        from dataclasses import asdict
+        inc.new_pools[pool_id] = asdict(spec)
+        await self.propose(inc)
+        return pool_id
+
+    async def _cmd_pool_rm(self, args: dict):
+        name = args["name"]
+        pid = self.osdmap.pool_names.get(name)
+        if pid is None:
+            raise ValueError(f"no pool {name}")
+        inc = Incremental(epoch=0)
+        inc.removed_pools.append(pid)
+        await self.propose(inc)
+        return pid
+
+    def _cmd_osd_tree(self):
+        tree = []
+        hosts = defaultdict(list)
+        for osd, host in self.osd_hosts.items():
+            hosts[host].append(osd)
+        for host in sorted(hosts):
+            tree.append({"type": "host", "name": host})
+            for osd in sorted(hosts[host]):
+                info = self.osdmap.osds.get(osd)
+                tree.append({"type": "osd", "id": osd,
+                             "up": bool(info and info.up),
+                             "in": bool(info and info.in_cluster),
+                             "weight": info.weight if info else 0})
+        return tree
+
+    # -- ticking (down->out aging) -----------------------------------------
+    async def _tick_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(0.5)
+                await self._tick()
+        except asyncio.CancelledError:
+            pass
+
+    async def _tick(self) -> None:
+        now = time.monotonic()
+        interval = self.config["mon_osd_down_out_interval"]
+        to_out = [osd for osd, t in self._down_since.items()
+                  if now - t > interval
+                  and self.osdmap.osds.get(osd)
+                  and self.osdmap.osds[osd].in_cluster
+                  and not self.osdmap.osds[osd].up]
+        if to_out:
+            inc = Incremental(epoch=0)
+            inc.new_out.extend(to_out)
+            for osd in to_out:
+                self._down_since.pop(osd, None)
+            await self.propose(inc)

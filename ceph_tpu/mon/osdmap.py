@@ -1,0 +1,298 @@
+"""OSDMap: the cluster map clients and OSDs both compute placement from.
+
+Semantics mirrored from src/osd/OSDMap.cc: object->pg via the rjenkins
+string hash and ceph_stable_mod (:2606-2624, src/include/rados.h:96),
+pg->osds via pps = crush_hash32_2(stable_mod(ps, pgp_num, mask), pool)
+(src/osd/osd_types.cc:1817) into crush_do_rule, nonexistent-osd filtering
+(:2651), primary = first mapped shard.  Maps evolve by Incrementals keyed
+by epoch, exactly how the reference distributes MOSDMap deltas.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from typing import Any
+
+from ..crush import (
+    CrushMap, crush_do_rule, ceph_str_hash_rjenkins, crush_hash32_2,
+)
+from ..crush.types import (
+    Bucket, Rule, RuleStep, Tunables, CRUSH_ITEM_NONE,
+)
+
+POOL_TYPE_REPLICATED = 1
+POOL_TYPE_ERASURE = 3
+
+
+def calc_bits_of(n: int) -> int:
+    return n.bit_length()
+
+
+def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
+    return x & bmask if (x & bmask) < b else x & (bmask >> 1)
+
+
+@dataclass
+class PoolSpec:
+    pool_id: int
+    name: str
+    type: int = POOL_TYPE_REPLICATED
+    size: int = 3
+    min_size: int = 2
+    pg_num: int = 32
+    pgp_num: int = 32
+    crush_rule: int = 0
+    erasure_code_profile: str = ""
+    flags: int = 1  # FLAG_HASHPSPOOL
+
+    @property
+    def pg_num_mask(self) -> int:
+        return (1 << calc_bits_of(self.pg_num - 1)) - 1
+
+    @property
+    def pgp_num_mask(self) -> int:
+        return (1 << calc_bits_of(self.pgp_num - 1)) - 1
+
+    def hash_key(self, key: str, nspace: str = "") -> int:
+        if nspace:
+            data = nspace.encode() + b"\x1f" + key.encode()
+        else:
+            data = key.encode()
+        return ceph_str_hash_rjenkins(data)
+
+    def raw_pg_to_pps(self, ps: int) -> int:
+        if self.flags & 1:
+            return crush_hash32_2(
+                ceph_stable_mod(ps, self.pgp_num, self.pgp_num_mask),
+                self.pool_id)
+        return ceph_stable_mod(ps, self.pgp_num, self.pgp_num_mask) + \
+            self.pool_id
+
+    def raw_pg_to_pg(self, ps: int) -> int:
+        return ceph_stable_mod(ps, self.pg_num, self.pg_num_mask)
+
+    def is_erasure(self) -> bool:
+        return self.type == POOL_TYPE_ERASURE
+
+    def can_shift_osds(self) -> bool:
+        return not self.is_erasure()
+
+
+@dataclass
+class OsdInfo:
+    up: bool = False
+    in_cluster: bool = True
+    weight: int = 0x10000          # reweight, 16.16
+    addr: tuple[str, int] | None = None
+    uuid: str = ""
+    down_at_epoch: int = 0
+
+
+@dataclass
+class Incremental:
+    epoch: int
+    new_up: dict[int, list] = field(default_factory=dict)     # osd -> addr
+    new_down: list[int] = field(default_factory=list)
+    new_in: list[int] = field(default_factory=list)
+    new_out: list[int] = field(default_factory=list)
+    new_weights: dict[int, int] = field(default_factory=dict)
+    new_pools: dict[int, dict] = field(default_factory=dict)
+    removed_pools: list[int] = field(default_factory=list)
+    new_crush: dict | None = None
+    new_ec_profiles: dict[str, dict] = field(default_factory=dict)
+    removed_ec_profiles: list[str] = field(default_factory=list)
+    new_max_osd: int | None = None
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["new_up"] = {str(k): v for k, v in self.new_up.items()}
+        d["new_weights"] = {str(k): v for k, v in self.new_weights.items()}
+        d["new_pools"] = {str(k): v for k, v in self.new_pools.items()}
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Incremental":
+        return cls(
+            epoch=d["epoch"],
+            new_up={int(k): v for k, v in d.get("new_up", {}).items()},
+            new_down=list(d.get("new_down", [])),
+            new_in=list(d.get("new_in", [])),
+            new_out=list(d.get("new_out", [])),
+            new_weights={int(k): v
+                         for k, v in d.get("new_weights", {}).items()},
+            new_pools={int(k): v for k, v in d.get("new_pools", {}).items()},
+            removed_pools=list(d.get("removed_pools", [])),
+            new_crush=d.get("new_crush"),
+            new_ec_profiles=dict(d.get("new_ec_profiles", {})),
+            removed_ec_profiles=list(d.get("removed_ec_profiles", [])),
+            new_max_osd=d.get("new_max_osd"),
+        )
+
+
+def crush_to_dict(cm: CrushMap) -> dict:
+    return {
+        "buckets": [
+            {"id": b.id, "type": b.type, "alg": b.alg, "hash": b.hash,
+             "items": list(b.items), "item_weights": list(b.item_weights),
+             "name": cm.bucket_names.get(b.id, "")}
+            for b in cm.buckets.values()
+        ],
+        "rules": [
+            {"rule_id": r.rule_id, "type": r.type,
+             "steps": [[s.op, s.arg1, s.arg2] for s in r.steps]}
+            for r in cm.rules.values()
+        ],
+        "tunables": asdict(cm.tunables),
+        "max_devices": cm.max_devices,
+    }
+
+
+def crush_from_dict(d: dict) -> CrushMap:
+    cm = CrushMap(tunables=Tunables(**d.get("tunables", {})))
+    for bd in d.get("buckets", []):
+        b = Bucket(id=bd["id"], type=bd["type"], alg=bd["alg"],
+                   hash=bd.get("hash", 0), items=list(bd["items"]),
+                   item_weights=list(bd["item_weights"]))
+        cm.add_bucket(b, bd.get("name") or None)
+    for rd in d.get("rules", []):
+        cm.add_rule(Rule(rule_id=rd["rule_id"], type=rd["type"],
+                         steps=[RuleStep(*s) for s in rd["steps"]]))
+    cm.max_devices = max(cm.max_devices, d.get("max_devices", 0))
+    return cm
+
+
+class OSDMap:
+    def __init__(self) -> None:
+        self.epoch = 0
+        self.max_osd = 0
+        self.osds: dict[int, OsdInfo] = {}
+        self.pools: dict[int, PoolSpec] = {}
+        self.pool_names: dict[str, int] = {}
+        self.crush = CrushMap()
+        self.ec_profiles: dict[str, dict] = {}
+
+    # -- queries ------------------------------------------------------------
+    def exists(self, osd: int) -> bool:
+        return osd in self.osds
+
+    def is_up(self, osd: int) -> bool:
+        return osd in self.osds and self.osds[osd].up
+
+    def get_pool_by_name(self, name: str) -> PoolSpec | None:
+        pid = self.pool_names.get(name)
+        return None if pid is None else self.pools.get(pid)
+
+    def osd_weights(self) -> list[int]:
+        """CRUSH input weight vector: 0 for down+out, reweight otherwise."""
+        n = max([self.max_osd] + [o + 1 for o in self.osds]) if self.osds \
+            else self.max_osd
+        w = [0] * n
+        for osd, info in self.osds.items():
+            if info.in_cluster and info.up:
+                w[osd] = info.weight
+        return w
+
+    # -- placement ----------------------------------------------------------
+    def object_to_pg(self, pool_id: int, name: str, nspace: str = "",
+                     key: str = "") -> tuple[int, int]:
+        pool = self.pools[pool_id]
+        ps = pool.hash_key(key or name, nspace)
+        return pool_id, ps
+
+    def pg_to_up_acting_osds(self, pool_id: int, ps: int) -> list[int]:
+        pool = self.pools[pool_id]
+        pps = pool.raw_pg_to_pps(pool.raw_pg_to_pg(ps))
+        weights = self.osd_weights()
+        raw = crush_do_rule(self.crush, pool.crush_rule, pps, pool.size,
+                            weights)
+        # filter nonexistent osds
+        if pool.can_shift_osds():
+            out = [o for o in raw
+                   if o != CRUSH_ITEM_NONE and self.exists(o)]
+        else:
+            out = [o if (o != CRUSH_ITEM_NONE and self.exists(o))
+                   else CRUSH_ITEM_NONE for o in raw]
+        return out
+
+    def pg_primary(self, up: list[int]) -> int | None:
+        for o in up:
+            if o != CRUSH_ITEM_NONE:
+                return o
+        return None
+
+    def pg_name(self, pool_id: int, ps: int) -> str:
+        pool = self.pools[pool_id]
+        return f"{pool_id}.{pool.raw_pg_to_pg(ps):x}"
+
+    def pg_ids(self, pool_id: int) -> list[str]:
+        pool = self.pools[pool_id]
+        return [f"{pool_id}.{i:x}" for i in range(pool.pg_num)]
+
+    # -- mutation -----------------------------------------------------------
+    def apply_incremental(self, inc: Incremental) -> None:
+        assert inc.epoch == self.epoch + 1, (inc.epoch, self.epoch)
+        self.epoch = inc.epoch
+        if inc.new_max_osd is not None:
+            self.max_osd = inc.new_max_osd
+        for osd, addr in inc.new_up.items():
+            info = self.osds.setdefault(osd, OsdInfo())
+            info.up = True
+            info.addr = tuple(addr) if addr else None
+        for osd in inc.new_down:
+            if osd in self.osds:
+                self.osds[osd].up = False
+                self.osds[osd].down_at_epoch = inc.epoch
+        for osd in inc.new_in:
+            self.osds.setdefault(osd, OsdInfo()).in_cluster = True
+        for osd in inc.new_out:
+            if osd in self.osds:
+                self.osds[osd].in_cluster = False
+        for osd, w in inc.new_weights.items():
+            self.osds.setdefault(osd, OsdInfo()).weight = w
+        for pid, pd in inc.new_pools.items():
+            spec = PoolSpec(**pd)
+            self.pools[pid] = spec
+            self.pool_names[spec.name] = pid
+        for pid in inc.removed_pools:
+            spec = self.pools.pop(pid, None)
+            if spec:
+                self.pool_names.pop(spec.name, None)
+        if inc.new_crush is not None:
+            self.crush = crush_from_dict(inc.new_crush)
+        for name, profile in inc.new_ec_profiles.items():
+            self.ec_profiles[name] = dict(profile)
+        for name in inc.removed_ec_profiles:
+            self.ec_profiles.pop(name, None)
+
+    # -- serialization ------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "max_osd": self.max_osd,
+            "osds": {str(o): {"up": i.up, "in": i.in_cluster,
+                              "weight": i.weight, "addr": i.addr,
+                              "uuid": i.uuid,
+                              "down_at": i.down_at_epoch}
+                     for o, i in self.osds.items()},
+            "pools": {str(p): asdict(s) for p, s in self.pools.items()},
+            "crush": crush_to_dict(self.crush),
+            "ec_profiles": self.ec_profiles,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OSDMap":
+        m = cls()
+        m.epoch = d["epoch"]
+        m.max_osd = d["max_osd"]
+        for o, i in d.get("osds", {}).items():
+            m.osds[int(o)] = OsdInfo(
+                up=i["up"], in_cluster=i["in"], weight=i["weight"],
+                addr=tuple(i["addr"]) if i.get("addr") else None,
+                uuid=i.get("uuid", ""), down_at_epoch=i.get("down_at", 0))
+        for p, s in d.get("pools", {}).items():
+            spec = PoolSpec(**s)
+            m.pools[int(p)] = spec
+            m.pool_names[spec.name] = int(p)
+        m.crush = crush_from_dict(d["crush"])
+        m.ec_profiles = dict(d.get("ec_profiles", {}))
+        return m

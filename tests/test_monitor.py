@@ -1,0 +1,224 @@
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import Message, Messenger
+from ceph_tpu.mon import Monitor
+from ceph_tpu.mon.osdmap import OSDMap
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+async def boot_osd(mon_addr, client, uuid, host, osd_id=None):
+    reply = asyncio.Queue()
+
+    async def d(conn, msg):
+        if msg.type == "osd_boot_ack":
+            await reply.put(msg.data)
+
+    client.add_dispatcher(d)
+    await client.send(mon_addr, "mon.0",
+                      Message("osd_boot", {"uuid": uuid, "host": host,
+                                           "addr": ["127.0.0.1", 7000],
+                                           "osd_id": osd_id}))
+    return await asyncio.wait_for(reply.get(), 5)
+
+
+async def command(mon_addr, client, cmd, args=None):
+    q = asyncio.Queue()
+
+    async def d(conn, msg):
+        if msg.type == "mon_command_reply":
+            await q.put(msg.data)
+
+    client.add_dispatcher(d)
+    await client.send(mon_addr, "mon.0",
+                      Message("mon_command", {"cmd": cmd, "args": args or {}}))
+    data = await asyncio.wait_for(q.get(), 5)
+    client.dispatchers.remove(d)
+    if not data["ok"]:
+        raise RuntimeError(data["error"])
+    return data["result"]
+
+
+def test_osd_boot_and_map_epoch():
+    async def main():
+        mon = Monitor()
+        addr = await mon.start()
+        osd = Messenger("osd.x")
+        ack = await boot_osd(addr, osd, "uuid-1", "hostA")
+        assert ack["osd_id"] == 0
+        assert mon.osdmap.epoch == 1
+        assert mon.osdmap.is_up(0)
+        ack2 = await boot_osd(addr, Messenger("osd.y"), "uuid-2", "hostB")
+        assert ack2["osd_id"] == 1
+        await osd.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_pool_create_replicated_and_mapping():
+    async def main():
+        mon = Monitor()
+        addr = await mon.start()
+        for i in range(3):
+            await boot_osd(addr, Messenger(f"osd.m{i}"), f"u{i}", f"host{i}")
+        cl = Messenger("client.t")
+        pid = await command(addr, cl, "osd pool create",
+                           {"name": "rbd", "pg_num": 8, "size": 3})
+        assert pid in mon.osdmap.pools
+        pool = mon.osdmap.pools[pid]
+        assert pool.pg_num == 8
+        # mapping works and spreads over the three hosts
+        up = mon.osdmap.pg_to_up_acting_osds(pid, 12345)
+        assert len(up) == 3 and len(set(up)) == 3
+        await cl.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_pool_create_erasure_with_profile():
+    async def main():
+        mon = Monitor()
+        addr = await mon.start()
+        for i in range(6):
+            await boot_osd(addr, Messenger(f"osd.e{i}"), f"eu{i}", f"h{i}")
+        cl = Messenger("client.e")
+        await command(addr, cl, "osd erasure-code-profile set",
+                      {"name": "myec",
+                       "profile": {"plugin": "isa", "k": "4", "m": "2",
+                                   "technique": "reed_sol_van"}})
+        assert "myec" in mon.osdmap.ec_profiles
+        pid = await command(addr, cl, "osd pool create",
+                            {"name": "ecpool", "type": "erasure",
+                             "erasure_code_profile": "myec", "pg_num": 8})
+        pool = mon.osdmap.pools[pid]
+        assert pool.size == 6 and pool.is_erasure()
+        up = mon.osdmap.pg_to_up_acting_osds(pid, 999)
+        assert len(up) == 6
+        await cl.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_bad_ec_profile_rejected():
+    async def main():
+        mon = Monitor()
+        addr = await mon.start()
+        cl = Messenger("client.bad")
+        with pytest.raises(RuntimeError):
+            await command(addr, cl, "osd erasure-code-profile set",
+                          {"name": "bad",
+                           "profile": {"plugin": "isa", "k": "1", "m": "2"}})
+        await cl.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_failure_reports_mark_down():
+    async def main():
+        mon = Monitor(config={"mon_osd_min_down_reporters": 2})
+        addr = await mon.start()
+        for i in range(4):
+            await boot_osd(addr, Messenger(f"osd.f{i}"), f"fu{i}", f"fh{i}")
+        assert mon.osdmap.is_up(2)
+        r0 = Messenger("osd.0")
+        r1 = Messenger("osd.1")
+        await r0.send(addr, "mon.0", Message("osd_failure", {"target": 2}))
+        await asyncio.sleep(0.05)
+        assert mon.osdmap.is_up(2)   # one reporter is not enough
+        await r1.send(addr, "mon.0", Message("osd_failure", {"target": 2}))
+        await asyncio.sleep(0.1)
+        assert not mon.osdmap.is_up(2)
+        await r0.shutdown()
+        await r1.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_subscription_pushes_incrementals():
+    async def main():
+        mon = Monitor()
+        addr = await mon.start()
+        sub = Messenger("client.sub")
+        maps = []
+        incs = []
+
+        async def d(conn, msg):
+            if msg.type == "osdmap_full":
+                maps.append(msg.data["map"])
+            elif msg.type == "osdmap_inc":
+                incs.append(msg.data["inc"])
+
+        sub.add_dispatcher(d)
+        await sub.send(addr, "mon.0", Message("sub_osdmap", {}))
+        await asyncio.sleep(0.05)
+        assert maps and maps[0]["epoch"] == 0
+        await boot_osd(addr, Messenger("osd.s"), "su", "sh")
+        await asyncio.sleep(0.1)
+        assert incs and incs[0]["epoch"] == 1
+        # reconstruct a map from full + incs
+        m = OSDMap.from_dict(maps[0])
+        from ceph_tpu.mon.osdmap import Incremental
+        for i in incs:
+            m.apply_incremental(Incremental.from_dict(i))
+        assert m.epoch == mon.osdmap.epoch
+        assert m.is_up(0)
+        await sub.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_down_out_aging():
+    async def main():
+        mon = Monitor(config={"mon_osd_min_down_reporters": 1,
+                              "mon_osd_down_out_interval": 0.3})
+        addr = await mon.start()
+        for i in range(3):
+            await boot_osd(addr, Messenger(f"osd.a{i}"), f"au{i}", f"ah{i}")
+        rep = Messenger("osd.0")
+        await rep.send(addr, "mon.0", Message("osd_failure", {"target": 1}))
+        await asyncio.sleep(0.2)
+        assert not mon.osdmap.is_up(1)
+        assert mon.osdmap.osds[1].in_cluster
+        await asyncio.sleep(1.0)
+        assert not mon.osdmap.osds[1].in_cluster  # aged out
+        await rep.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_three_mon_paxos_replication():
+    async def main():
+        mons = [Monitor(rank=r, peers=[None, None, None])
+                for r in range(3)]
+        addrs = []
+        for m in mons:
+            addrs.append(await m.start())
+        for m in mons:
+            m.peer_addrs = list(addrs)
+            m.quorum = {0, 1, 2}
+        leader = mons[0]
+        await boot_osd(addrs[0], Messenger("osd.p"), "pu", "ph")
+        await asyncio.sleep(0.2)
+        assert leader.osdmap.epoch == 1
+        assert mons[1].osdmap.epoch == 1
+        assert mons[2].osdmap.epoch == 1
+        assert mons[1].osdmap.is_up(0)
+        for m in mons:
+            await m.stop()
+
+    run(main())

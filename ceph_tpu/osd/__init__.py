@@ -1,0 +1,18 @@
+"""OSD data plane: PGs, log-based recovery, replicated/EC backends.
+
+Functional rendering of src/osd: the PG op path (PrimaryLogPG.cc),
+per-PG op logs with divergent-entry rewind (PGLog.h), the peering
+protocol that agrees on authoritative history after map changes
+(PeeringState.h), and the PGBackend split into replication fan-out
+vs erasure-coded read-modify-write (PGBackend.cc:570).
+"""
+
+from .types import EVersion, LogEntry, PGInfo, MissingSet, PastIntervals
+from .pg_log import PGLog
+from .ec_util import StripeInfo
+from .scheduler import MClockScheduler, OpClass
+
+__all__ = [
+    "EVersion", "LogEntry", "PGInfo", "MissingSet", "PastIntervals",
+    "PGLog", "StripeInfo", "MClockScheduler", "OpClass",
+]

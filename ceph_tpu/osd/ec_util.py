@@ -1,0 +1,137 @@
+"""EC stripe math: logical object space <-> per-shard chunk space.
+
+Mirrors src/osd/ECUtil.h stripe_info_t (:27-117): a pool-wide
+stripe_width = k * chunk_size; a logical object offset maps to
+(stripe index, chunk offset); shard s of an object holds the
+concatenation of that object's chunk s across all stripes.
+ECUtil::encode/decode (:21,134) drive the plugin per whole stripe.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+
+class StripeInfo:
+    def __init__(self, k: int, m: int, stripe_width: int) -> None:
+        assert stripe_width % k == 0, (stripe_width, k)
+        self.k = k
+        self.m = m
+        self.stripe_width = stripe_width
+        self.chunk_size = stripe_width // k
+
+    @classmethod
+    def for_codec(cls, codec, stripe_unit: int = 4096) -> "StripeInfo":
+        """Build a StripeInfo whose chunk_size matches the codec's
+        aligned get_chunk_size — the same adjustment pool creation does
+        (OSDMonitor::prepare_pool_stripe_width, OSDMonitor.cc:7782).
+        """
+        k = codec.get_data_chunk_count()
+        m = codec.get_coding_chunk_count()
+        chunk = codec.get_chunk_size(stripe_unit * k)
+        return cls(k, m, chunk * k)
+
+    def _check_codec(self, codec) -> None:
+        # codecs align chunks up (SIMD_ALIGN); a mismatched stripe_width
+        # would slice shard buffers at the wrong boundaries
+        cs = codec.get_chunk_size(self.stripe_width)
+        assert cs == self.chunk_size, (
+            f"stripe_width {self.stripe_width} gives codec chunk_size "
+            f"{cs}, StripeInfo expects {self.chunk_size}; build via "
+            f"StripeInfo.for_codec")
+
+    # -- offset maps (ECUtil.h:58-96) ---------------------------------------
+    def logical_to_prev_stripe_offset(self, offset: int) -> int:
+        return offset - (offset % self.stripe_width)
+
+    def logical_to_next_stripe_offset(self, offset: int) -> int:
+        rem = offset % self.stripe_width
+        return offset if rem == 0 else offset + self.stripe_width - rem
+
+    def aligned_logical_offset_to_chunk_offset(self, offset: int) -> int:
+        assert offset % self.stripe_width == 0, offset
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def chunk_aligned_logical_offset_to_chunk_offset(
+            self, offset: int) -> int:
+        return self.aligned_logical_offset_to_chunk_offset(
+            self.logical_to_prev_stripe_offset(offset))
+
+    def aligned_chunk_offset_to_logical_offset(self, offset: int) -> int:
+        assert offset % self.chunk_size == 0, offset
+        return (offset // self.chunk_size) * self.stripe_width
+
+    def object_size_to_shard_size(self, size: int) -> int:
+        """On-shard bytes for a logical object of `size` bytes."""
+        return self.aligned_logical_offset_to_chunk_offset(
+            self.logical_to_next_stripe_offset(size))
+
+    def offset_len_to_stripe_bounds(
+            self, offset: int, length: int) -> tuple[int, int]:
+        """Expand [offset, offset+length) to stripe-aligned bounds."""
+        start = self.logical_to_prev_stripe_offset(offset)
+        end = self.logical_to_next_stripe_offset(offset + length)
+        return start, end - start
+
+    # -- stripe encode/decode drivers (ECUtil.cc:21,134) --------------------
+    def encode(self, codec, data: bytes) -> dict[int, np.ndarray]:
+        """Encode whole stripes of `data` into k+m shard buffers.
+
+        `data` must be stripe-aligned (pad first).  Each shard buffer is
+        the concatenation of its chunk across stripes.
+        """
+        self._check_codec(codec)
+        assert len(data) % self.stripe_width == 0, len(data)
+        n_stripes = len(data) // self.stripe_width
+        want = set(range(self.k + self.m))
+        shards: dict[int, list[np.ndarray]] = {i: [] for i in want}
+        for s in range(n_stripes):
+            stripe = data[s * self.stripe_width:(s + 1) * self.stripe_width]
+            encoded = codec.encode(want, stripe)
+            for i in want:
+                shards[i].append(np.asarray(encoded[i], dtype=np.uint8))
+        return {i: (np.concatenate(bufs) if bufs
+                    else np.zeros(0, np.uint8))
+                for i, bufs in shards.items()}
+
+    def decode(self, codec, shard_bufs: Mapping[int, np.ndarray],
+               want: set[int] | None = None) -> dict[int, np.ndarray]:
+        """Reconstruct shard buffers (possibly all) from available shards.
+
+        Every shard buffer covers the same chunk range; decode runs
+        per-stripe through the plugin and reconcatenates.
+        """
+        self._check_codec(codec)
+        want = set(range(self.k)) if want is None else set(want)
+        lens = {len(b) for b in shard_bufs.values()}
+        assert len(lens) == 1, lens
+        shard_len = lens.pop()
+        assert shard_len % self.chunk_size == 0, shard_len
+        n_stripes = shard_len // self.chunk_size
+        out: dict[int, list[np.ndarray]] = {i: [] for i in want}
+        for s in range(n_stripes):
+            lo, hi = s * self.chunk_size, (s + 1) * self.chunk_size
+            chunks = {i: np.asarray(b[lo:hi], dtype=np.uint8)
+                      for i, b in shard_bufs.items()}
+            decoded = codec.decode(want, chunks)
+            for i in want:
+                out[i].append(decoded[i])
+        return {i: (np.concatenate(bufs) if bufs
+                    else np.zeros(0, np.uint8))
+                for i, bufs in out.items()}
+
+    def reconstruct_logical(self, codec,
+                            shard_bufs: Mapping[int, np.ndarray]) -> bytes:
+        """Rebuild the logical byte stream from shard buffers."""
+        data_shards = self.decode(codec, shard_bufs,
+                                  want=set(range(self.k)))
+        shard_len = len(next(iter(data_shards.values())))
+        n_stripes = shard_len // self.chunk_size
+        parts = []
+        for s in range(n_stripes):
+            lo, hi = s * self.chunk_size, (s + 1) * self.chunk_size
+            for i in range(self.k):
+                parts.append(np.asarray(data_shards[i][lo:hi]))
+        return b"".join(p.tobytes() for p in parts)

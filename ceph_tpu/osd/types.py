@@ -1,0 +1,181 @@
+"""PG-level value types: versions, log entries, pg_info, missing sets.
+
+Modeled on src/osd/osd_types.h: eversion_t (epoch, version) total order,
+pg_log_entry_t (:4325) with op/soid/version/prior_version, pg_info_t
+(last_update/last_complete/log_tail + history), and pg_missing_t
+(need/have per object, drives log-based recovery).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import total_ordering
+from typing import Any
+
+
+@total_ordering
+@dataclass(frozen=True)
+class EVersion:
+    """(epoch, version) — totally ordered op version stamp."""
+
+    epoch: int = 0
+    version: int = 0
+
+    def __lt__(self, other: "EVersion") -> bool:
+        return (self.epoch, self.version) < (other.epoch, other.version)
+
+    def __bool__(self) -> bool:
+        return self.epoch != 0 or self.version != 0
+
+    def to_list(self) -> list[int]:
+        return [self.epoch, self.version]
+
+    @classmethod
+    def from_list(cls, v) -> "EVersion":
+        return cls(int(v[0]), int(v[1]))
+
+
+ZERO = EVersion()
+
+# op kinds (pg_log_entry_t::Op subset the data path exercises)
+MODIFY = "modify"
+DELETE = "delete"
+ERROR = "error"
+
+
+@dataclass
+class LogEntry:
+    """One mutation in a PG's op log."""
+
+    op: str
+    oid: str
+    version: EVersion
+    prior_version: EVersion = ZERO
+    mutations: list[dict[str, Any]] = field(default_factory=list)
+
+    def is_delete(self) -> bool:
+        return self.op == DELETE
+
+    def to_dict(self) -> dict:
+        return {"op": self.op, "oid": self.oid,
+                "v": self.version.to_list(),
+                "pv": self.prior_version.to_list(),
+                "m": self.mutations}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LogEntry":
+        return cls(op=d["op"], oid=d["oid"],
+                   version=EVersion.from_list(d["v"]),
+                   prior_version=EVersion.from_list(d["pv"]),
+                   mutations=list(d.get("m", [])))
+
+
+@dataclass
+class PGInfo:
+    """Summary of a PG replica's history (pg_info_t)."""
+
+    pgid: str = ""
+    last_update: EVersion = ZERO          # newest log entry applied
+    last_complete: EVersion = ZERO        # all objects ≤ this recovered
+    log_tail: EVersion = ZERO             # oldest entry still in log
+    last_epoch_started: int = 0
+    same_interval_since: int = 0
+
+    def is_empty(self) -> bool:
+        return not self.last_update
+
+    def to_dict(self) -> dict:
+        return {"pgid": self.pgid,
+                "last_update": self.last_update.to_list(),
+                "last_complete": self.last_complete.to_list(),
+                "log_tail": self.log_tail.to_list(),
+                "last_epoch_started": self.last_epoch_started,
+                "same_interval_since": self.same_interval_since}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PGInfo":
+        return cls(pgid=d["pgid"],
+                   last_update=EVersion.from_list(d["last_update"]),
+                   last_complete=EVersion.from_list(d["last_complete"]),
+                   log_tail=EVersion.from_list(d["log_tail"]),
+                   last_epoch_started=d.get("last_epoch_started", 0),
+                   same_interval_since=d.get("same_interval_since", 0))
+
+
+class MissingSet:
+    """Objects a replica lacks: oid -> (need, have) (pg_missing_t)."""
+
+    def __init__(self) -> None:
+        self.items: dict[str, tuple[EVersion, EVersion]] = {}
+
+    def add(self, oid: str, need: EVersion, have: EVersion) -> None:
+        prev = self.items.get(oid)
+        if prev is not None:
+            have = prev[1]      # keep the original on-disk version
+        self.items[oid] = (need, have)
+
+    def rm(self, oid: str, at: EVersion) -> None:
+        cur = self.items.get(oid)
+        if cur is not None and cur[0] <= at:
+            del self.items[oid]
+
+    def revise_need(self, oid: str, need: EVersion) -> None:
+        have = self.items.get(oid, (ZERO, ZERO))[1]
+        self.items[oid] = (need, have)
+
+    def is_missing(self, oid: str) -> bool:
+        return oid in self.items
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+    def __bool__(self) -> bool:
+        return bool(self.items)
+
+    def to_dict(self) -> dict:
+        return {oid: [need.to_list(), have.to_list()]
+                for oid, (need, have) in self.items.items()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MissingSet":
+        ms = cls()
+        for oid, (need, have) in d.items():
+            ms.items[oid] = (EVersion.from_list(need),
+                             EVersion.from_list(have))
+        return ms
+
+
+class PastIntervals:
+    """Acting-set history across map epochs (compact form).
+
+    Enough to answer "may this peer have data we need?": the union of
+    acting OSDs over intervals since last_epoch_started
+    (src/osd/osd_types.h PastIntervals is the heavyweight original).
+    """
+
+    def __init__(self) -> None:
+        self.intervals: list[dict] = []   # {first, last, acting}
+
+    def note_interval(self, first: int, last: int,
+                      acting: list[int]) -> None:
+        self.intervals.append({"first": first, "last": last,
+                               "acting": list(acting)})
+
+    def probe_targets(self, current_acting: list[int]) -> set[int]:
+        osds = {o for o in current_acting if o >= 0}
+        for iv in self.intervals:
+            osds.update(o for o in iv["acting"] if o >= 0)
+        return osds
+
+    def clear_to(self, epoch: int) -> None:
+        self.intervals = [iv for iv in self.intervals
+                          if iv["last"] >= epoch]
+
+    def to_dict(self) -> dict:
+        return {"intervals": self.intervals}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PastIntervals":
+        pi = cls()
+        pi.intervals = list(d.get("intervals", []))
+        return pi

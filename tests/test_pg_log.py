@@ -1,0 +1,129 @@
+"""PGLog merge / divergent-rewind / replica-missing semantics.
+
+Scenario structure follows src/test/osd/TestPGLog.cc: build two logs
+with a shared prefix, diverge them, merge, and check head/entries and
+the missing set.
+"""
+
+import pytest
+
+from ceph_tpu.osd import EVersion, LogEntry, MissingSet, PGInfo, PGLog
+from ceph_tpu.osd.types import DELETE, MODIFY, ZERO
+
+
+def ent(oid, e, v, pe=0, pv=0, op=MODIFY):
+    return LogEntry(op=op, oid=oid, version=EVersion(e, v),
+                    prior_version=EVersion(pe, pv))
+
+
+def mklog(entries):
+    log = PGLog()
+    for e in entries:
+        log.add(e)
+    return log
+
+
+def info_for(log, pgid="1.0"):
+    return PGInfo(pgid=pgid, last_update=log.head,
+                  last_complete=log.head, log_tail=log.tail)
+
+
+def test_add_and_trim():
+    log = mklog([ent("a", 1, 1), ent("b", 1, 2), ent("a", 1, 3, 1, 1)])
+    assert log.head == EVersion(1, 3)
+    log.trim(EVersion(1, 2))
+    assert [e.version.version for e in log.entries] == [3]
+    assert log.tail == EVersion(1, 2)
+
+
+def test_merge_extends_and_marks_missing():
+    shared = [ent("a", 1, 1), ent("b", 1, 2)]
+    ours = mklog(shared)
+    auth = mklog(shared + [ent("c", 2, 3), ent("a", 2, 4, 1, 1)])
+    missing = MissingSet()
+    ours.merge(auth.entries, info_for(auth), missing)
+    assert ours.head == EVersion(2, 4)
+    assert missing.is_missing("c")
+    assert missing.is_missing("a")
+    need, have = missing.items["a"]
+    assert need == EVersion(2, 4)
+    assert have == EVersion(1, 1)
+    assert not missing.is_missing("b")
+
+
+def test_merge_delete_clears_missing():
+    shared = [ent("a", 1, 1)]
+    ours = mklog(shared)
+    auth = mklog(shared + [ent("a", 2, 2, 1, 1, op=DELETE)])
+    missing = MissingSet()
+    ours.merge(auth.entries, info_for(auth), missing)
+    assert not missing.is_missing("a")
+
+
+def test_rewind_divergent():
+    shared = [ent("a", 1, 1), ent("b", 1, 2)]
+    # we wrote two entries the cluster never committed
+    ours = mklog(shared + [ent("a", 2, 3, 1, 1), ent("c", 2, 4)])
+    auth = mklog(shared)
+    missing = MissingSet()
+    ours.merge(auth.entries, info_for(auth), missing)
+    assert ours.head == EVersion(1, 2)
+    assert len(ours.entries) == 2
+    # 'a' must be restored to its authoritative version 1,1
+    assert missing.items["a"][0] == EVersion(1, 1)
+    # 'c' was created only by a divergent entry: not missing, just gone
+    assert not missing.is_missing("c")
+
+
+def test_merge_divergence_below_auth_head():
+    """Divergent local entries BELOW the auth head must still rewind.
+
+    Old primary applied (2,3),(2,4) that never replicated; the survivor
+    meanwhile committed (3,3).  Splice point is the last shared entry,
+    not a head comparison.
+    """
+    shared = [ent("a", 1, 1), ent("b", 1, 2)]
+    old_primary = mklog(shared + [ent("a", 2, 3, 1, 1), ent("new", 2, 4)])
+    auth = mklog(shared + [ent("b", 3, 3, 1, 2, op=DELETE)])
+    missing = MissingSet()
+    old_primary.merge(auth.entries, info_for(auth), missing)
+    assert old_primary.head == EVersion(3, 3)
+    assert [(e.op, e.oid) for e in old_primary.entries] == [
+        (MODIFY, "a"), (MODIFY, "b"), (DELETE, "b")]
+    assert missing.items["a"][0] == EVersion(1, 1)
+    assert not missing.is_missing("new")   # created only divergently
+    assert not missing.is_missing("b")     # deleted authoritatively
+
+
+def test_proc_replica_log_behind():
+    shared = [ent("a", 1, 1)]
+    auth = mklog(shared + [ent("b", 2, 2), ent("a", 2, 3, 1, 1)])
+    replica = mklog(shared)
+    missing = PGLog.proc_replica_log(info_for(replica), replica.entries, auth)
+    assert set(missing.items) == {"a", "b"}
+    assert missing.items["a"][0] == EVersion(2, 3)
+
+
+def test_proc_replica_log_divergent():
+    shared = [ent("a", 1, 1)]
+    auth = mklog(shared + [ent("a", 3, 2, 1, 1)])
+    # replica applied a write that never committed cluster-wide
+    replica = mklog(shared + [ent("a", 2, 2, 1, 1)])
+    # divergent: replica's (2,2) > auth head? no — auth head (3,2) > (2,2),
+    # so replica is simply behind; auth entry (3,2) marks 'a' missing
+    missing = PGLog.proc_replica_log(info_for(replica), replica.entries, auth)
+    assert missing.items["a"][0] == EVersion(3, 2)
+
+    # now truly divergent: replica head past auth head
+    auth2 = mklog(shared)
+    replica2 = mklog(shared + [ent("a", 2, 2, 1, 1)])
+    missing2 = PGLog.proc_replica_log(info_for(replica2),
+                                      replica2.entries, auth2)
+    assert missing2.items["a"][0] == EVersion(1, 1)
+
+
+def test_roundtrip_dict():
+    log = mklog([ent("a", 1, 1), ent("b", 1, 2)])
+    log2 = PGLog.from_dict(log.to_dict())
+    assert log2.head == log.head
+    assert [e.oid for e in log2.entries] == ["a", "b"]

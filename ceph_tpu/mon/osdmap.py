@@ -205,12 +205,14 @@ class OSDMap:
         weights = self.osd_weights()
         raw = crush_do_rule(self.crush, pool.crush_rule, pps, pool.size,
                             weights)
-        # filter nonexistent osds
+        # filter nonexistent/down osds (_raw_to_up_osds, OSDMap.cc:2773):
+        # replicated pools shift the survivors up; EC pools keep NONE
+        # holes because the acting-set position IS the shard id
         if pool.can_shift_osds():
             out = [o for o in raw
-                   if o != CRUSH_ITEM_NONE and self.exists(o)]
+                   if o != CRUSH_ITEM_NONE and self.is_up(o)]
         else:
-            out = [o if (o != CRUSH_ITEM_NONE and self.exists(o))
+            out = [o if (o != CRUSH_ITEM_NONE and self.is_up(o))
                    else CRUSH_ITEM_NONE for o in raw]
         return out
 

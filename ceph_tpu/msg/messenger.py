@@ -40,7 +40,9 @@ class Connection:
         self.in_seq = 0
         self.unacked: deque[Message] = deque()
         self.closed = False
+        self.generation = 0          # bumped per successful reconnect
         self._send_lock = asyncio.Lock()
+        self._reconnect_lock = asyncio.Lock()
         self._read_task: asyncio.Task | None = None
 
     async def send(self, msg: Message) -> None:
@@ -80,11 +82,23 @@ class Messenger:
     def __init__(self, name: str, secret: bytes | None = None) -> None:
         self.name = name
         self.secret = secret
+        # incarnation distinguishes a restarted peer from a reconnecting
+        # one (ProtocolV2's global_seq/connect_seq split): a new
+        # incarnation resets the replay-dedup session, a reconnect of
+        # the same incarnation resumes it
+        self.incarnation = os.urandom(8).hex()
         self.dispatchers: list[Dispatcher] = []
-        self.conns: dict[str, Connection] = {}       # by peer name
+        # one connection per peer per DIRECTION: simultaneous cross-
+        # connects between two daemons are legal and never race over a
+        # shared slot (the reference arbitrates the same race with
+        # ProtocolV2 global_seq; separate directions sidestep it)
+        self.conns: dict[str, Connection] = {}       # outgoing, by peer
+        self.conns_in: dict[str, Connection] = {}    # accepted, by peer
         # per-peer last delivered seq; survives reconnects so replayed
         # messages dedup (the lossless policy's session state)
         self._sessions: dict[str, int] = {}
+        self._session_inst: dict[str, str] = {}      # peer -> incarnation
+        self._connect_locks: dict[str, asyncio.Lock] = {}
         self._server: asyncio.base_events.Server | None = None
         self.addr: tuple[str, int] | None = None
         self._accept_tasks: set[asyncio.Task] = set()
@@ -100,20 +114,37 @@ class Messenger:
 
     async def _on_accept(self, reader, writer) -> None:
         try:
-            peer_name = await self._handshake_server(reader, writer)
+            peer_name, inst = await self._handshake_server_read(
+                reader, writer)
         except (asyncio.IncompleteReadError, ValueError, ConnectionError):
             writer.close()
             return
-        conn = Connection(self, peer_name, reader, writer, outgoing=False)
-        conn.in_seq = self._sessions.get(peer_name, 0)
-        old = self.conns.get(peer_name)
-        if old is not None and not old.outgoing:
+        # close any stale conn from this peer BEFORE touching session
+        # state: its read loop must not repopulate _sessions with an
+        # old seq between our reset and the in_seq snapshot below
+        old = self.conns_in.get(peer_name)
+        if old is not None:
             await old.close()
-        self.conns[peer_name] = conn
+        if self._session_inst.get(peer_name) != inst:
+            # restarted peer: fresh session, no replay dedup state
+            self._session_inst[peer_name] = inst
+            self._sessions.pop(peer_name, None)
+        last_seq = self._sessions.get(peer_name, 0)
+        try:
+            writer.write(b"ACK!" + struct.pack("<Q", last_seq))
+            await writer.drain()
+        except (ConnectionError, OSError):
+            writer.close()
+            return
+        conn = Connection(self, peer_name, reader, writer, outgoing=False)
+        conn.in_seq = last_seq
+        self.conns_in[peer_name] = conn
         conn._read_task = asyncio.ensure_future(self._read_loop(conn))
 
     # -- handshake (HMAC challenge, cephx-lite) ------------------------------
-    async def _handshake_server(self, reader, writer) -> str:
+    async def _handshake_server_read(self, reader, writer) -> tuple[str, str]:
+        """Server side up to (not including) the ACK: returns
+        (peer name, peer incarnation)."""
         nonce = os.urandom(16)
         writer.write(HELLO_MAGIC + struct.pack("<16s", nonce))
         await writer.drain()
@@ -129,10 +160,7 @@ class Messenger:
                 writer.write(b"NACK")
                 await writer.drain()
                 raise ValueError("auth failure")
-        last_seq = self._sessions.get(payload["name"], 0)
-        writer.write(b"ACK!" + struct.pack("<Q", last_seq))
-        await writer.drain()
-        return payload["name"]
+        return payload["name"], payload.get("inst", "")
 
     async def _handshake_client(self, reader, writer) -> None:
         hdr = await reader.readexactly(20)
@@ -143,6 +171,7 @@ class Messenger:
         if self.secret is not None:
             proof = hmac.new(self.secret, nonce, hashlib.sha256).digest()
         payload = json.dumps({"name": self.name,
+                              "inst": self.incarnation,
                               "proof": proof.hex()}).encode()
         writer.write(HELLO_MAGIC + struct.pack("<I", len(payload)) + payload)
         await writer.drain()
@@ -155,39 +184,79 @@ class Messenger:
     # -- client -------------------------------------------------------------
     async def connect(self, addr: tuple[str, int],
                       peer_name: str) -> Connection:
-        conn = self.conns.get(peer_name)
-        if conn is not None and not conn.closed:
+        # serialize per peer: N concurrent sends must share ONE
+        # connection, not race N handshakes (the acceptor keeps a single
+        # incoming conn per peer and would drop the losers mid-flight)
+        lock = self._connect_locks.setdefault(peer_name, asyncio.Lock())
+        async with lock:
+            replay: list[Message] = []
+            conn = self.conns.get(peer_name)
+            if conn is not None and not conn.closed:
+                if conn.outgoing and conn.peer_addr is not None \
+                        and tuple(conn.peer_addr) != tuple(addr):
+                    # peer rebound to a new address: the cached conn
+                    # points at a dead endpoint; carry its unacked
+                    # messages over (lossless policy)
+                    replay = list(conn.unacked)
+                    await conn.close()
+                else:
+                    return conn
+            elif conn is not None and conn.closed:
+                replay = list(conn.unacked)
+            reader, writer = await asyncio.open_connection(
+                addr[0], addr[1])
+            last_seq = await self._handshake_client(reader, writer)
+            conn = Connection(self, peer_name, reader, writer,
+                              outgoing=True, peer_addr=addr)
+            # continue the server's seq space: a same-incarnation
+            # session survives connection churn, and starting below
+            # last_seq would get every message deduped as a replay
+            conn.out_seq = last_seq
+            self.conns[peer_name] = conn
+            conn._read_task = asyncio.ensure_future(self._read_loop(conn))
+            for msg in replay:
+                if msg.seq > last_seq:
+                    await conn.send(msg)     # re-stamps seq past last_seq
             return conn
-        reader, writer = await asyncio.open_connection(addr[0], addr[1])
-        await self._handshake_client(reader, writer)
-        conn = Connection(self, peer_name, reader, writer, outgoing=True,
-                          peer_addr=addr)
-        self.conns[peer_name] = conn
-        conn._read_task = asyncio.ensure_future(self._read_loop(conn))
-        return conn
 
     async def _reconnect(self, conn: Connection) -> None:
-        """Lossless policy: reopen and replay unacked in order."""
+        """Lossless policy: reopen and replay unacked in order.
+
+        Serialized per connection — the send error path and the
+        read-loop EOF path can both request a reconnect concurrently;
+        the second requester finds the generation already advanced and
+        returns without racing reader/writer swaps.
+        """
         if conn.peer_addr is None:
             await conn.close()
             raise ConnectionError("incoming connection lost")
-        for attempt in range(5):
-            try:
-                reader, writer = await asyncio.open_connection(
-                    conn.peer_addr[0], conn.peer_addr[1])
-                last_seq = await self._handshake_client(reader, writer)
-                while conn.unacked and conn.unacked[0].seq <= last_seq:
-                    conn.unacked.popleft()
-                conn.reader, conn.writer = reader, writer
-                if conn._read_task:
-                    conn._read_task.cancel()
-                conn._read_task = asyncio.ensure_future(self._read_loop(conn))
-                await conn._resend_unacked()
-                return
-            except (ConnectionError, OSError):
-                await asyncio.sleep(0.05 * (2 ** attempt))
-        await conn.close()
-        raise ConnectionError(f"reconnect to {conn.peer_name} failed")
+        gen = conn.generation
+        async with conn._reconnect_lock:
+            if conn.closed:
+                raise ConnectionError(f"{conn.peer_name} closed")
+            if conn.generation != gen:
+                return               # someone else already reconnected
+            for attempt in range(5):
+                try:
+                    reader, writer = await asyncio.open_connection(
+                        conn.peer_addr[0], conn.peer_addr[1])
+                    last_seq = await self._handshake_client(reader, writer)
+                    while conn.unacked and conn.unacked[0].seq <= last_seq:
+                        conn.unacked.popleft()
+                    conn.reader, conn.writer = reader, writer
+                    # server->client stream restarts on the new accept
+                    conn.in_seq = 0
+                    conn.generation += 1
+                    if conn._read_task:
+                        conn._read_task.cancel()
+                    conn._read_task = asyncio.ensure_future(
+                        self._read_loop(conn))
+                    await conn._resend_unacked()
+                    return
+                except (ConnectionError, OSError):
+                    await asyncio.sleep(0.05 * (2 ** attempt))
+            await conn.close()
+            raise ConnectionError(f"reconnect to {conn.peer_name} failed")
 
     async def send(self, addr: tuple[str, int], peer_name: str,
                    msg: Message) -> None:
@@ -205,16 +274,61 @@ class Messenger:
                 conn.in_seq = msg.seq
                 if not conn.outgoing:
                     self._sessions[conn.peer_name] = msg.seq
-                for d in self.dispatchers:
-                    await d(conn, msg)
-        except (asyncio.IncompleteReadError, ConnectionError,
-                asyncio.CancelledError, ValueError):
+                # dispatch in a task: a handler that itself RPCs back to
+                # this peer must not block the read loop its reply rides
+                # on (the reference's DispatchQueue decoupling).  Task
+                # creation order preserves ordering for handlers'
+                # synchronous prefixes.
+                t = asyncio.ensure_future(self._dispatch_one(conn, msg))
+                self._accept_tasks.add(t)
+                t.add_done_callback(self._accept_tasks.discard)
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            if conn.outgoing and not conn.closed:
+                # lossless policy: try to re-establish and replay
+                # unacked; on failure the conn is closed so connect()
+                # replaces it instead of returning a cached corpse
+                try:
+                    t = asyncio.ensure_future(self._try_reconnect(conn))
+                    self._accept_tasks.add(t)
+                    t.add_done_callback(self._accept_tasks.discard)
+                except RuntimeError:      # event loop shutting down
+                    conn.closed = True
+            else:
+                conn.closed = True
+                try:
+                    conn.writer.close()
+                except Exception:
+                    pass
+        except asyncio.CancelledError:
             pass
 
+    async def _try_reconnect(self, conn: Connection) -> None:
+        try:
+            await self._reconnect(conn)
+        except (ConnectionError, OSError):
+            pass
+
+    async def _dispatch_one(self, conn: Connection, msg: Message) -> None:
+        for d in list(self.dispatchers):
+            try:
+                await d(conn, msg)
+            except (ConnectionError, OSError):
+                pass
+
     async def shutdown(self) -> None:
-        for conn in list(self.conns.values()):
+        for t in list(self._accept_tasks):
+            t.cancel()
+        for conn in (list(self.conns.values())
+                     + list(self.conns_in.values())):
             await conn.close()
         self.conns.clear()
+        self.conns_in.clear()
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # 3.12 wait_closed blocks until every peer transport is
+            # gone; peers shutting down concurrently make that a
+            # deadlock, so bound it
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 1.0)
+            except asyncio.TimeoutError:
+                pass

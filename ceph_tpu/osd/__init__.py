@@ -11,8 +11,10 @@ from .types import EVersion, LogEntry, PGInfo, MissingSet, PastIntervals
 from .pg_log import PGLog
 from .ec_util import StripeInfo
 from .scheduler import MClockScheduler, OpClass
+from .osd import OSD
+from .pg import PG
 
 __all__ = [
     "EVersion", "LogEntry", "PGInfo", "MissingSet", "PastIntervals",
-    "PGLog", "StripeInfo", "MClockScheduler", "OpClass",
+    "PGLog", "StripeInfo", "MClockScheduler", "OpClass", "OSD", "PG",
 ]

@@ -1,0 +1,365 @@
+"""PGBackend: replication fan-out vs erasure-coded shard I/O.
+
+The SPI mirrors src/osd/PGBackend.cc:570 build_pg_backend — the pool
+type selects ReplicatedBackend (primary-copy fan-out, MOSDRepOp) or
+ECBackend (encode + per-shard sub-writes, MOSDECSubOpWrite; reads
+gather minimum_to_decode shards and reconstruct, ECCommon.cc:597).
+
+Mutations are resolved to concrete, offset-explicit ops at the primary
+(append/writefull become plain writes) so replicas and shards apply
+them deterministically — the same discipline as
+PrimaryLogPG ops -> ObjectStore::Transaction translation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..os.transaction import Transaction
+from .ec_util import StripeInfo
+from .types import LogEntry
+
+META_OID = "_pgmeta_"
+SIZE_XATTR = "_size"
+
+
+# -- wire packing: JSON meta + binary segments ------------------------------
+
+def pack_mutations(muts: list[dict]) -> tuple[list[dict], list[bytes]]:
+    meta, segments = [], []
+    for m in muts:
+        m2 = dict(m)
+        for key in ("data", "value"):
+            if key in m2 and isinstance(m2[key], (bytes, bytearray,
+                                                  np.ndarray)):
+                buf = bytes(m2[key]) if not isinstance(
+                    m2[key], np.ndarray) else m2[key].tobytes()
+                m2[key] = {"seg": len(segments), "len": len(buf)}
+                segments.append(buf)
+        if "kv" in m2:
+            kv = m2["kv"]
+            buf = b"".join(
+                len(k.encode()).to_bytes(4, "big") + k.encode()
+                + len(v).to_bytes(4, "big") + bytes(v)
+                for k, v in kv.items())
+            m2["kv"] = {"seg": len(segments), "n": len(kv)}
+            segments.append(buf)
+        meta.append(m2)
+    return meta, segments
+
+
+def unpack_mutations(meta: list[dict],
+                     segments: list[bytes]) -> list[dict]:
+    out = []
+    for m in meta:
+        m2 = dict(m)
+        for key in ("data", "value"):
+            if isinstance(m2.get(key), dict):
+                m2[key] = segments[m2[key]["seg"]]
+        if isinstance(m2.get("kv"), dict):
+            buf = segments[m2["kv"]["seg"]]
+            kv, pos = {}, 0
+            for _ in range(m2["kv"]["n"]):
+                klen = int.from_bytes(buf[pos:pos + 4], "big"); pos += 4
+                k = buf[pos:pos + klen].decode(); pos += klen
+                vlen = int.from_bytes(buf[pos:pos + 4], "big"); pos += 4
+                kv[k] = buf[pos:pos + vlen]; pos += vlen
+            m2["kv"] = kv
+        out.append(m2)
+    return out
+
+
+def apply_mutations(txn: Transaction, coll: str, oid: str,
+                    muts: list[dict]) -> None:
+    """Translate resolved logical mutations into Transaction ops."""
+    for m in muts:
+        op = m["op"]
+        if op == "create":
+            txn.touch(coll, oid)
+        elif op == "write":
+            txn.write(coll, oid, m["off"], m["data"])
+        elif op == "truncate":
+            txn.truncate(coll, oid, m["size"])
+        elif op == "zero":
+            txn.zero(coll, oid, m["off"], m["len"])
+        elif op == "remove":
+            txn.remove(coll, oid)
+        elif op == "setxattr":
+            txn.setattr(coll, oid, m["name"], m["value"])
+        elif op == "rmxattr":
+            txn.rmattr(coll, oid, m["name"])
+        elif op == "omap_set":
+            txn.omap_setkeys(coll, oid, m["kv"])
+        elif op == "omap_rm":
+            txn.omap_rmkeys(coll, oid, m["keys"])
+        elif op == "omap_clear":
+            txn.omap_clear(coll, oid)
+        else:
+            raise ValueError(f"unknown mutation op {op}")
+
+
+class PGBackend:
+    """SPI both backends implement; `pg` provides log/info/persistence
+    and `osd` provides peer RPC + the local store."""
+
+    def __init__(self, pg) -> None:
+        self.pg = pg
+        self.osd = pg.osd
+
+    @property
+    def store(self):
+        return self.osd.store
+
+    @property
+    def coll(self) -> str:
+        return self.pg.coll
+
+    async def submit_transaction(self, entry: LogEntry,
+                                 muts: list[dict]) -> None:
+        raise NotImplementedError
+
+    async def object_read(self, oid: str, off: int,
+                          length: int | None) -> bytes:
+        raise NotImplementedError
+
+    async def object_size(self, oid: str) -> int:
+        raise NotImplementedError
+
+    # recovery: full-object state transfer units
+    async def read_recovery_payload(self, oid: str, shard: int) -> dict:
+        raise NotImplementedError
+
+
+def build_pg_backend(pg):
+    """PGBackend.cc:570 — pool type picks the backend."""
+    if pg.pool.is_erasure():
+        return ECBackend(pg)
+    return ReplicatedBackend(pg)
+
+
+class ReplicatedBackend(PGBackend):
+    async def submit_transaction(self, entry, muts) -> None:
+        txn = Transaction()
+        apply_mutations(txn, self.coll, entry.oid, muts)
+        self.pg.append_log_and_meta(txn, entry)
+        self.store.queue_transaction(txn)
+        # fan out to every other acting replica and wait for all commits
+        # (ReplicatedBackend.cc: all_commit before client reply)
+        meta, segs = pack_mutations(muts)
+        payload = {"pgid": self.pg.pgid, "entry": entry.to_dict(),
+                   "muts": meta}
+        await self.osd.fanout_and_wait(
+            [(o, "rep_op", payload, segs) for o in self.pg.acting
+             if o >= 0 and o != self.osd.whoami])
+
+    def apply_rep_op(self, entry: LogEntry, muts: list[dict]) -> None:
+        """Replica side: apply the primary's resolved mutations."""
+        txn = Transaction()
+        apply_mutations(txn, self.coll, entry.oid, muts)
+        self.pg.append_log_and_meta(txn, entry)
+        self.store.queue_transaction(txn)
+
+    async def object_read(self, oid, off, length) -> bytes:
+        return self.store.read(self.coll, oid, off, length)
+
+    async def object_size(self, oid) -> int:
+        st = self.store.stat(self.coll, oid)
+        return 0 if st is None else st["size"]
+
+    async def read_recovery_payload(self, oid, shard) -> dict:
+        try:
+            data = self.store.read(self.coll, oid, 0, None)
+        except FileNotFoundError:
+            return {"data": b"", "xattrs": {}, "omap": {},
+                    "absent": True}
+        return {"data": data,
+                "xattrs": self.store.getattrs(self.coll, oid),
+                "omap": self.store.omap_get(self.coll, oid)}
+
+
+class ECBackend(PGBackend):
+    """Erasure-coded object I/O over acting-set shards.
+
+    Shard i of every object lives on acting[i] (shard id = position in
+    the acting set, ErasureCodeInterface.h:39-78).  Writes run
+    full-object RMW: reconstruct current logical bytes, apply the
+    mutation, re-encode, distribute per-shard sub-writes
+    (ECCommon.cc:704 start_rmw — partial-stripe overwrite support via
+    an extent cache is future work; this always rewrites the stripe
+    set, which is correct if pessimal for tiny overwrites).
+    """
+
+    def __init__(self, pg) -> None:
+        super().__init__(pg)
+        profile = dict(pg.ec_profile)
+        plugin = profile.pop("plugin", "tpu")
+        from ..ec import registry
+        self.codec = registry().factory(plugin, profile)
+        self.sinfo = StripeInfo.for_codec(
+            self.codec, stripe_unit=int(profile.get("stripe_unit", 4096)))
+
+    @property
+    def k(self) -> int:
+        return self.sinfo.k
+
+    def my_shard(self) -> int:
+        return self.pg.acting.index(self.osd.whoami)
+
+    # -- logical object reconstruction --------------------------------------
+    async def _gather_shards(self, oid: str,
+                             need_shards: set[int] | None = None
+                             ) -> tuple[dict[int, np.ndarray], int]:
+        """Read enough shard buffers to decode; returns (bufs, size)."""
+        acting = self.pg.acting
+        avail: dict[int, int] = {}           # shard -> osd
+        for shard, osd in enumerate(acting):
+            if osd >= 0 and self.osd.osd_is_up(osd):
+                avail[shard] = osd
+        plan = self.codec.minimum_to_decode(
+            need_shards or set(range(self.k)), set(avail))
+        bufs: dict[int, np.ndarray] = {}
+        size = 0
+        local = self.my_shard() if self.osd.whoami in acting else None
+        remote = []
+        for shard in plan:
+            if shard == local:
+                try:
+                    raw = self.store.read(self.coll, oid, 0, None)
+                except FileNotFoundError:
+                    raw = b""
+                bufs[shard] = np.frombuffer(raw, np.uint8)
+                sx = self.store.getattr(self.coll, oid, SIZE_XATTR)
+                size = int(sx) if sx else 0
+            else:
+                remote.append((avail[shard], shard))
+        if remote:
+            replies = await self.osd.fanout_and_wait(
+                [(osd, "ec_subop_read",
+                  {"pgid": self.pg.pgid, "oid": oid}, [])
+                 for osd, _ in remote], collect=True)
+            for rep in replies:
+                shard = rep.data["shard"]
+                bufs[shard] = np.frombuffer(
+                    rep.segments[0] if rep.segments else b"", np.uint8)
+                size = max(size, rep.data.get("size", 0))
+        # normalize buffer lengths (a shard that never saw the object
+        # returns empty: zero-fill to the common shard length)
+        shard_len = max((len(b) for b in bufs.values()), default=0)
+        for s, b in list(bufs.items()):
+            if len(b) < shard_len:
+                nb = np.zeros(shard_len, np.uint8)
+                nb[:len(b)] = b
+                bufs[s] = nb
+        return bufs, size
+
+    async def _read_logical(self, oid: str) -> bytes:
+        bufs, size = await self._gather_shards(oid)
+        if not bufs or not any(len(b) for b in bufs.values()):
+            return b""
+        data = self.sinfo.reconstruct_logical(self.codec, bufs)
+        return data[:size]
+
+    # -- write path ---------------------------------------------------------
+    async def submit_transaction(self, entry, muts) -> None:
+        """Full-object RMW: new logical content -> k+m shard writes."""
+        data_muts = [m for m in muts if m["op"] in
+                     ("create", "write", "truncate", "zero", "remove")]
+        attr_muts = [m for m in muts if m not in data_muts]
+        if any(m["op"] != "create" for m in data_muts):
+            logical = bytearray(await self._read_logical(entry.oid))
+            for m in data_muts:
+                if m["op"] == "write":
+                    end = m["off"] + len(m["data"])
+                    if len(logical) < end:
+                        logical.extend(b"\0" * (end - len(logical)))
+                    logical[m["off"]:end] = m["data"]
+                elif m["op"] == "truncate":
+                    if len(logical) < m["size"]:
+                        logical.extend(b"\0" * (m["size"] - len(logical)))
+                    else:
+                        del logical[m["size"]:]
+                elif m["op"] == "zero":
+                    end = min(m["off"] + m["len"], len(logical))
+                    logical[m["off"]:end] = b"\0" * max(0, end - m["off"])
+            remove = any(m["op"] == "remove" for m in data_muts)
+        else:
+            logical, remove = bytearray(), False
+
+        acting = self.pg.acting
+        if remove:
+            per_shard = [{"remove": True} for _ in acting]
+            segs_per_shard = [[] for _ in acting]
+        else:
+            size = len(logical)
+            padded = bytes(logical) + b"\0" * (
+                self.sinfo.logical_to_next_stripe_offset(size) - size)
+            if padded:
+                shards = self.sinfo.encode(self.codec, padded)
+            else:
+                shards = {i: np.zeros(0, np.uint8)
+                          for i in range(len(acting))}
+            per_shard, segs_per_shard = [], []
+            for shard in range(len(acting)):
+                buf = shards[shard].tobytes()
+                per_shard.append({"size": size, "shard_len": len(buf),
+                                  "attrs": None})
+                segs_per_shard.append([buf])
+        # local shard applies in-line; remote shards via ec_subop_write
+        awaiting = []
+        for shard, osd in enumerate(acting):
+            if osd < 0:
+                continue
+            payload = {"pgid": self.pg.pgid, "oid": entry.oid,
+                       "shard": shard, "entry": entry.to_dict(),
+                       "w": per_shard[shard],
+                       "attr_muts": pack_mutations(attr_muts)[0]}
+            segs = segs_per_shard[shard] + pack_mutations(attr_muts)[1]
+            if osd == self.osd.whoami:
+                self.apply_sub_write(entry, payload["w"],
+                                     segs_per_shard[shard], attr_muts)
+            else:
+                awaiting.append((osd, "ec_subop_write", payload, segs))
+        if awaiting:
+            await self.osd.fanout_and_wait(awaiting)
+
+    def apply_sub_write(self, entry: LogEntry, w: dict,
+                        segs: list[bytes], attr_muts: list[dict]) -> None:
+        txn = Transaction()
+        oid = entry.oid
+        if w.get("remove"):
+            txn.remove(self.coll, oid)
+        else:
+            buf = segs[0] if segs else b""
+            txn.truncate(self.coll, oid, 0)
+            txn.write(self.coll, oid, 0, buf)
+            txn.truncate(self.coll, oid, w["shard_len"])
+            txn.setattr(self.coll, oid, SIZE_XATTR,
+                        str(w["size"]).encode())
+        apply_mutations(txn, self.coll, oid, attr_muts)
+        self.pg.append_log_and_meta(txn, entry)
+        self.store.queue_transaction(txn)
+
+    # -- read path ----------------------------------------------------------
+    async def object_read(self, oid, off, length) -> bytes:
+        data = await self._read_logical(oid)
+        if length is None:
+            return data[off:]
+        return data[off:off + length]
+
+    async def object_size(self, oid) -> int:
+        sx = self.store.getattr(self.coll, oid, SIZE_XATTR)
+        if sx is not None:
+            return int(sx)
+        _, size = await self._gather_shards(oid)
+        return size
+
+    async def read_recovery_payload(self, oid, shard) -> dict:
+        """Reconstruct the target shard's buffer for a recovering peer."""
+        bufs, size = await self._gather_shards(oid, need_shards={shard})
+        if shard in bufs:
+            buf = bufs[shard]
+        else:
+            buf = self.sinfo.decode(self.codec, bufs, want={shard})[shard]
+        return {"data": buf.tobytes(),
+                "xattrs": {SIZE_XATTR: str(size).encode()},
+                "omap": {}}

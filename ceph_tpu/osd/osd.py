@@ -1,0 +1,507 @@
+"""OSD daemon: boot, map handling, heartbeats, op dispatch, PG hosting.
+
+Mirrors the src/osd/OSD.cc skeleton: boot to the monitor, subscribe to
+OSDMap deltas, a ping mesh with failure reports past a grace period
+(handle_osd_ping :5767, heartbeat_check :6138), fast dispatch of client
+ops into per-PG execution (ms_fast_dispatch :7550 -> dequeue_op :9793),
+and dmClock admission for client vs recovery work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+import uuid as uuid_mod
+
+from ..mon.osdmap import OSDMap, Incremental
+from ..msg import Message, Messenger
+from ..os.store import MemStore
+from .pg import PG
+from .scheduler import MClockScheduler, OpClass
+
+
+class OSD:
+    def __init__(self, uuid: str | None = None, whoami: int | None = None,
+                 store=None, host: str = "host0",
+                 secret: bytes | None = None,
+                 config: dict | None = None) -> None:
+        self.uuid = uuid or uuid_mod.uuid4().hex
+        self.whoami = whoami if whoami is not None else -1
+        self.host = host
+        self.store = store or MemStore()
+        self.config = {
+            "osd_heartbeat_interval": 0.5,
+            "osd_heartbeat_grace": 3.0,
+            **(config or {}),
+        }
+        self.secret = secret
+        self.msgr: Messenger | None = None
+        self.mon_addr: tuple[str, int] | None = None
+        self.osdmap = OSDMap()
+        self.pgs: dict[str, PG] = {}
+        self.sched = MClockScheduler()
+        self._sched_event = asyncio.Event()
+        self._tid = itertools.count(1)
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._hb_last: dict[int, float] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = False
+        self._rebooting = False
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self, mon_addr: tuple[str, int],
+                    host: str = "127.0.0.1", port: int = 0) -> int:
+        self.mon_addr = tuple(mon_addr)
+        self.store.mount()
+        name = f"osd.{self.whoami}" if self.whoami >= 0 else \
+            f"osd-boot-{self.uuid[:8]}"
+        self.msgr = Messenger(name, secret=self.secret)
+        self.msgr.add_dispatcher(self._dispatch)
+        addr = await self.msgr.bind(host, port)
+        ack = await self._mon_request(
+            "osd_boot", {"uuid": self.uuid, "host": self.host,
+                         "addr": list(addr),
+                         "osd_id": self.whoami if self.whoami >= 0
+                         else None},
+            reply_type="osd_boot_ack")
+        self.whoami = ack["osd_id"]
+        self.msgr.name = f"osd.{self.whoami}"
+        # subscribe to map deltas; mon replies with the full map
+        full = await self._mon_request("sub_osdmap", {},
+                                       reply_type="osdmap_full")
+        self._apply_full_map(full["map"])
+        self._tasks = [
+            asyncio.ensure_future(self._heartbeat_loop()),
+            asyncio.ensure_future(self._sched_loop()),
+        ]
+        return self.whoami
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for pg in self.pgs.values():
+            if pg._recovery_task:
+                pg._recovery_task.cancel()
+        if self.msgr:
+            await self.msgr.shutdown()
+        self.store.umount()
+
+    async def _mon_request(self, mtype: str, data: dict,
+                           reply_type: str, timeout: float = 10) -> dict:
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def d(conn, msg):
+            if msg.type == reply_type:
+                await q.put(msg.data)
+
+        self.msgr.add_dispatcher(d)
+        try:
+            await self.msgr.send(self.mon_addr, "mon.0",
+                                 Message(mtype, data))
+            return await asyncio.wait_for(q.get(), timeout)
+        finally:
+            self.msgr.dispatchers.remove(d)
+
+    # -- map handling -------------------------------------------------------
+    def _apply_full_map(self, map_dict: dict) -> None:
+        self.osdmap = OSDMap.from_dict(map_dict)
+        self._on_map_change()
+
+    def _apply_incremental(self, inc_dict: dict) -> None:
+        inc = Incremental.from_dict(inc_dict)
+        if inc.epoch != self.osdmap.epoch + 1:
+            asyncio.ensure_future(self._catch_up_maps())
+            return
+        self.osdmap.apply_incremental(inc)
+        self._on_map_change()
+
+    async def _catch_up_maps(self) -> None:
+        try:
+            full = await self._mon_request("sub_osdmap", {},
+                                           reply_type="osdmap_full")
+            self._apply_full_map(full["map"])
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+
+    def _on_map_change(self) -> None:
+        """Instantiate/retarget PGs after an epoch change."""
+        t0 = time.monotonic()
+        epoch = self.osdmap.epoch
+        for pool_id, pool in self.osdmap.pools.items():
+            profile = None
+            if pool.is_erasure():
+                profile = self.osdmap.ec_profiles.get(
+                    pool.erasure_code_profile)
+            for ps in range(pool.pg_num):
+                up = self.osdmap.pg_to_up_acting_osds(pool_id, ps)
+                pgid = self.osdmap.pg_name(pool_id, ps)
+                involved = self.whoami in up
+                pg = self.pgs.get(pgid)
+                if pg is None:
+                    if not involved:
+                        continue
+                    pg = PG(self, pgid, pool, profile)
+                    self.pgs[pgid] = pg
+                changed = pg.update_mapping(up, list(up), epoch)
+                if changed and pg.is_primary():
+                    t = asyncio.ensure_future(pg.peer())
+                    self._tasks.append(t)
+                    t.add_done_callback(
+                        lambda t: t in self._tasks
+                        and self._tasks.remove(t))
+        # drop PGs for deleted pools
+        live_pools = set(self.osdmap.pools)
+        for pgid in list(self.pgs):
+            pool_id = int(pgid.split(".")[0])
+            if pool_id not in live_pools:
+                self.pgs.pop(pgid)
+        # restart the failure-detection clock for peers currently down
+        # so a re-booted OSD is not instantly re-reported from a stale
+        # last-heard timestamp
+        for osd, info in self.osdmap.osds.items():
+            if not info.up:
+                self._hb_last.pop(osd, None)
+        # a long synchronous map change stalls OUR event loop; peers
+        # were not silent, we were deaf — credit the stall to the
+        # failure-detection clocks
+        stall = time.monotonic() - t0
+        if stall > 0.05:
+            for osd in self._hb_last:
+                self._hb_last[osd] += stall
+        # falsely marked down (we are clearly alive): re-assert with a
+        # fresh boot, as the reference OSD does on seeing itself down
+        # in a new map
+        me = self.osdmap.osds.get(self.whoami)
+        if (me is not None and not me.up and not self._stopped
+                and not self._rebooting):
+            self._rebooting = True
+            t = asyncio.ensure_future(self._reboot())
+            self._tasks.append(t)
+
+    async def _reboot(self) -> None:
+        try:
+            await asyncio.sleep(0.2)     # let the down epoch settle
+            await self._mon_request(
+                "osd_boot", {"uuid": self.uuid, "host": self.host,
+                             "addr": list(self.msgr.addr),
+                             "osd_id": self.whoami},
+                reply_type="osd_boot_ack")
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            self._rebooting = False
+
+    def _get_pg(self, pgid: str) -> PG | None:
+        pg = self.pgs.get(pgid)
+        if pg is not None:
+            return pg
+        # a peer knows about a PG we have not instantiated yet (e.g. a
+        # query raced our map delivery): create it if the pool exists
+        try:
+            pool_id = int(pgid.split(".")[0])
+        except ValueError:
+            return None
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            return None
+        profile = self.osdmap.ec_profiles.get(
+            pool.erasure_code_profile) if pool.is_erasure() else None
+        pg = PG(self, pgid, pool, profile)
+        ps = int(pgid.split(".")[1])
+        up = self.osdmap.pg_to_up_acting_osds(pool_id, ps)
+        pg.update_mapping(up, list(up), self.osdmap.epoch)
+        self.pgs[pgid] = pg
+        return pg
+
+    def osd_is_up(self, osd: int) -> bool:
+        return osd == self.whoami or self.osdmap.is_up(osd)
+
+    # -- peer RPC -----------------------------------------------------------
+    def _peer_addr(self, osd: int) -> tuple[str, int]:
+        info = self.osdmap.osds.get(osd)
+        if info is None or info.addr is None:
+            raise ConnectionError(f"no address for osd.{osd}")
+        return tuple(info.addr)
+
+    async def fanout_and_wait(self, requests, collect: bool = False,
+                              timeout: float = 10):
+        """Send (osd, type, data, segments) requests; await all replies.
+
+        Replies are matched by tid (every handler echoes it).  Raises
+        TimeoutError if any peer fails to respond — callers treat that
+        as a failed sub-op (the op layer above re-peers on map change).
+        """
+        futs = []
+        for osd, mtype, data, segments in requests:
+            tid = next(self._tid)
+            fut = asyncio.get_event_loop().create_future()
+            self._waiters[tid] = fut
+            futs.append((tid, fut))
+            d = dict(data)
+            d["tid"] = tid
+            try:
+                await self.msgr.send(
+                    self._peer_addr(osd), f"osd.{osd}",
+                    Message(mtype, d, segments=list(segments)))
+            except (ConnectionError, OSError) as e:
+                if not fut.done():
+                    fut.set_exception(ConnectionError(str(e)))
+        try:
+            if futs:
+                done, pending = await asyncio.wait(
+                    [f for _, f in futs], timeout=timeout)
+            else:
+                done, pending = set(), set()
+        finally:
+            for tid, _ in futs:
+                self._waiters.pop(tid, None)
+        replies, errors = [], []
+        for f in done:
+            if f.exception() is not None:
+                errors.append(f.exception())
+            else:
+                replies.append(f.result())
+        for f in pending:
+            f.cancel()
+        if collect:
+            return replies      # partial results are fine (down peers)
+        if errors:
+            raise errors[0]
+        if pending:
+            raise asyncio.TimeoutError(
+                f"{len(pending)} sub-op replies outstanding")
+        return replies
+
+    def _resolve_tid(self, msg: Message) -> None:
+        fut = self._waiters.pop(msg.data.get("tid"), None)
+        if fut is not None and not fut.done():
+            fut.set_result(msg)
+
+    # -- dmclock admission --------------------------------------------------
+    async def admit(self, op_class: OpClass):
+        fut = asyncio.get_event_loop().create_future()
+        self.sched.enqueue(op_class, fut)
+        self._sched_event.set()
+        await fut
+
+    async def _sched_loop(self) -> None:
+        try:
+            while True:
+                await self._sched_event.wait()
+                item = self.sched.dequeue()
+                if item is None:
+                    self._sched_event.clear()
+                    continue
+                _, fut = item
+                if not fut.done():
+                    fut.set_result(None)
+                # yield so the admitted op actually starts
+                await asyncio.sleep(0)
+        except asyncio.CancelledError:
+            pass
+
+    # -- heartbeats ---------------------------------------------------------
+    async def _heartbeat_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.config["osd_heartbeat_interval"])
+                await self._heartbeat_once()
+        except asyncio.CancelledError:
+            pass
+
+    async def _ping_one(self, osd: int, now: float) -> None:
+        """One bounded ping send — a dead peer's connect/reconnect stall
+        must never block the heartbeat cycle (the reference runs a
+        dedicated hb messenger for the same reason)."""
+        try:
+            await asyncio.wait_for(
+                self.msgr.send(
+                    self._peer_addr(osd), f"osd.{osd}",
+                    Message("osd_ping", {"from_osd": self.whoami,
+                                         "stamp": now})), 1.0)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass
+
+    async def _heartbeat_once(self) -> None:
+        now = time.monotonic()
+        grace = self.config["osd_heartbeat_grace"]
+        # opportunistic recovery re-kick (a push/pull that raced a peer
+        # reboot backs off; the tick restarts it)
+        for pg in self.pgs.values():
+            if (pg.is_primary() and pg.state == "active"
+                    and pg._recovery_pending()):
+                pg.kick_recovery()
+        peers = [osd for osd, info in self.osdmap.osds.items()
+                 if osd != self.whoami and info.up]
+        await asyncio.gather(*(self._ping_one(o, now) for o in peers),
+                             return_exceptions=True)
+        for osd in peers:
+            last = self._hb_last.get(osd)
+            if last is None:
+                self._hb_last[osd] = now     # start the clock
+            elif now - last > grace:
+                # yield once so queued ping/reply handlers run, then
+                # re-check: distinguishes "peer silent" from "our loop
+                # was busy and the replies are still in the queue"
+                await asyncio.sleep(0)
+                last = self._hb_last.get(osd, now)
+                if now - last <= grace:
+                    continue
+                try:
+                    await self.msgr.send(
+                        self.mon_addr, "mon.0",
+                        Message("osd_failure", {"target": osd}))
+                except (ConnectionError, OSError):
+                    pass
+
+    # -- dispatch -----------------------------------------------------------
+    async def _dispatch(self, conn, msg: Message) -> None:
+        handler = getattr(self, f"_h_{msg.type}", None)
+        if handler is not None:
+            await handler(conn, msg)
+
+    async def _h_osdmap_inc(self, conn, msg) -> None:
+        self._apply_incremental(msg.data["inc"])
+
+    async def _h_osdmap_full(self, conn, msg) -> None:
+        self._apply_full_map(msg.data["map"])
+
+    async def _h_osd_ping(self, conn, msg) -> None:
+        self._hb_last[msg.data["from_osd"]] = time.monotonic()
+        await conn.send(Message("osd_ping_reply",
+                                {"from_osd": self.whoami,
+                                 "stamp": msg.data["stamp"]}))
+
+    async def _h_osd_ping_reply(self, conn, msg) -> None:
+        self._hb_last[msg.data["from_osd"]] = time.monotonic()
+
+    # client I/O
+    async def _h_osd_op(self, conn, msg) -> None:
+        await self.admit(OpClass.CLIENT)
+        pg = self._get_pg(msg.data["pgid"])
+        if pg is None:
+            await conn.send(Message(
+                "osd_op_reply", {"tid": msg.data.get("tid"),
+                                 "err": "ENXIO no such pg"}))
+            return
+        data, segments = await pg.do_op(msg)
+        data["tid"] = msg.data.get("tid")
+        data["epoch"] = self.osdmap.epoch
+        await conn.send(Message("osd_op_reply", data, segments=segments))
+
+    # replication / EC sub-ops
+    async def _h_rep_op(self, conn, msg) -> None:
+        from .types import LogEntry
+        from .backend import unpack_mutations
+        pg = self._get_pg(msg.data["pgid"])
+        if pg is not None:
+            entry = LogEntry.from_dict(msg.data["entry"])
+            muts = unpack_mutations(msg.data["muts"], msg.segments)
+            pg.backend.apply_rep_op(entry, muts)
+        await conn.send(Message("rep_op_reply",
+                                {"tid": msg.data.get("tid"),
+                                 "from_osd": self.whoami}))
+
+    async def _h_rep_op_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    async def _h_ec_subop_write(self, conn, msg) -> None:
+        from .types import LogEntry
+        from .backend import unpack_mutations
+        pg = self._get_pg(msg.data["pgid"])
+        if pg is not None:
+            entry = LogEntry.from_dict(msg.data["entry"])
+            w = msg.data["w"]
+            n_data_segs = 0 if w.get("remove") else 1
+            attr_muts = unpack_mutations(msg.data.get("attr_muts", []),
+                                         msg.segments[n_data_segs:])
+            pg.backend.apply_sub_write(
+                entry, w, msg.segments[:n_data_segs], attr_muts)
+        await conn.send(Message("ec_subop_write_reply",
+                                {"tid": msg.data.get("tid"),
+                                 "from_osd": self.whoami}))
+
+    async def _h_ec_subop_write_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    async def _h_ec_subop_read(self, conn, msg) -> None:
+        pg = self._get_pg(msg.data["pgid"])
+        data, buf, size = {"tid": msg.data.get("tid")}, b"", 0
+        if pg is not None:
+            oid = msg.data["oid"]
+            try:
+                buf = self.store.read(pg.coll, oid, 0, None)
+            except FileNotFoundError:
+                buf = b""
+            from .backend import SIZE_XATTR
+            sx = self.store.getattr(pg.coll, oid, SIZE_XATTR)
+            size = int(sx) if sx else 0
+            data["shard"] = pg._shard_of(self.whoami)
+            data["size"] = size
+        await conn.send(Message("ec_subop_read_reply", data,
+                                segments=[buf]))
+
+    async def _h_ec_subop_read_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    # peering
+    async def _h_pg_query(self, conn, msg) -> None:
+        pg = self._get_pg(msg.data["pgid"])
+        if pg is not None:
+            data = pg.on_query()
+        else:
+            from .types import PGInfo
+            data = {"pgid": msg.data["pgid"],
+                    "info": PGInfo(pgid=msg.data["pgid"]).to_dict(),
+                    "entries": [], "from_osd": self.whoami}
+        data["tid"] = msg.data.get("tid")
+        await conn.send(Message("pg_notify", data))
+
+    async def _h_pg_notify(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    async def _h_pg_activate(self, conn, msg) -> None:
+        pg = self._get_pg(msg.data["pgid"])
+        if pg is None:
+            await conn.send(Message("pg_activate_ack",
+                                    {"tid": msg.data.get("tid"),
+                                     "err": "ENXIO", "missing": {},
+                                     "from_osd": self.whoami}))
+            return
+        data = await pg.on_activate(msg)
+        data["tid"] = msg.data.get("tid")
+        await conn.send(Message("pg_activate_ack", data))
+
+    async def _h_pg_activate_ack(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    # recovery
+    async def _h_pg_pull(self, conn, msg) -> None:
+        pg = self._get_pg(msg.data["pgid"])
+        if pg is None:
+            await conn.send(Message("pg_pull_reply",
+                                    {"tid": msg.data.get("tid"),
+                                     "err": "ENXIO"}))
+            return
+        data, segments = await pg.on_pull(msg)
+        data["tid"] = msg.data.get("tid")
+        await conn.send(Message("pg_pull_reply", data, segments=segments))
+
+    async def _h_pg_pull_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    async def _h_pg_push(self, conn, msg) -> None:
+        pg = self._get_pg(msg.data["pgid"])
+        if pg is None:
+            await conn.send(Message("pg_push_reply",
+                                    {"tid": msg.data.get("tid"),
+                                     "err": "ENXIO"}))
+            return
+        data = await pg.on_push(msg)
+        data["tid"] = msg.data.get("tid")
+        await conn.send(Message("pg_push_reply", data))
+
+    async def _h_pg_push_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)

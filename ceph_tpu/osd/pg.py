@@ -1,0 +1,488 @@
+"""PG: per-placement-group op execution, peering, log-based recovery.
+
+The op path mirrors PrimaryLogPG (do_op -> execute -> issue_repop,
+PrimaryLogPG.cc:1982,4160,11456); peering follows the PeeringState
+machine's happy path GetInfo -> GetLog -> GetMissing -> Activate
+(PeeringState.h:645-680); recovery pulls objects the primary is
+missing and pushes to behind replicas (recover_primary/replicas,
+PrimaryLogPG.cc:13446,13719).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ..os.transaction import Transaction
+from .backend import (
+    META_OID, ReplicatedBackend, apply_mutations, build_pg_backend,
+    pack_mutations, unpack_mutations,
+)
+from .pg_log import PGLog
+from .scheduler import OpClass
+from .types import (
+    DELETE, EVersion, LogEntry, MissingSet, MODIFY, PGInfo, PastIntervals,
+    ZERO,
+)
+
+LOG_CAP = 512           # entries kept in the in-memory/persisted log
+
+# client op names that mutate
+WRITE_OPS = {"create", "write", "writefull", "append", "truncate", "zero",
+             "remove", "setxattr", "rmxattr", "omap_set", "omap_rm",
+             "omap_clear"}
+READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get", "list"}
+
+
+class PG:
+    def __init__(self, osd, pgid: str, pool, ec_profile: dict | None) -> None:
+        self.osd = osd
+        self.pgid = pgid
+        self.pool = pool
+        self.ec_profile = dict(ec_profile or {})
+        self.coll = f"pg_{pgid}"
+        self.log = PGLog()
+        self.info = PGInfo(pgid=pgid)
+        self.missing = MissingSet()
+        self.peer_info: dict[int, PGInfo] = {}
+        self.peer_log_entries: dict[int, list[LogEntry]] = {}
+        self.peer_missing: dict[int, MissingSet] = {}
+        self.past_intervals = PastIntervals()
+        self.up: list[int] = []
+        self.acting: list[int] = []
+        self.state = "initial"
+        self.lock = asyncio.Lock()
+        self._recovery_task: asyncio.Task | None = None
+        if not self.osd.store.collection_exists(self.coll):
+            txn = Transaction()
+            txn.create_collection(self.coll)
+            txn.touch(self.coll, META_OID)
+            self.osd.store.queue_transaction(txn)
+        self._load_meta()
+        self.backend = build_pg_backend(self)
+
+    # -- persistence --------------------------------------------------------
+    def _load_meta(self) -> None:
+        omap = self.osd.store.omap_get(self.coll, META_OID)
+        if "info" in omap:
+            self.info = PGInfo.from_dict(json.loads(omap["info"]))
+        if "log" in omap:
+            self.log = PGLog.from_dict(json.loads(omap["log"]))
+        if "missing" in omap:
+            self.missing = MissingSet.from_dict(json.loads(omap["missing"]))
+        if "past_intervals" in omap:
+            self.past_intervals = PastIntervals.from_dict(
+                json.loads(omap["past_intervals"]))
+
+    def _meta_kv(self) -> dict[str, bytes]:
+        return {
+            "info": json.dumps(self.info.to_dict()).encode(),
+            "log": json.dumps(self.log.to_dict()).encode(),
+            "missing": json.dumps(self.missing.to_dict()).encode(),
+            "past_intervals": json.dumps(
+                self.past_intervals.to_dict()).encode(),
+        }
+
+    def persist_meta(self, txn: Transaction | None = None) -> None:
+        own = txn is None
+        if own:
+            txn = Transaction()
+        txn.omap_setkeys(self.coll, META_OID, self._meta_kv())
+        if own:
+            self.osd.store.queue_transaction(txn)
+
+    def append_log_and_meta(self, txn: Transaction, entry: LogEntry) -> None:
+        """Log append + pg meta, in the SAME transaction as the data ops
+        (the atomic data+log commit log-based recovery depends on,
+        PGLog persisted via ObjectStore::Transaction)."""
+        if entry.version > self.log.head:
+            self.log.add(entry)
+            if len(self.log.entries) > LOG_CAP:
+                self.log.trim(self.log.entries[-LOG_CAP].version)
+            self.info.last_update = entry.version
+            if not self.missing:
+                self.info.last_complete = entry.version
+        self.persist_meta(txn)
+
+    # -- role / mapping -----------------------------------------------------
+    @property
+    def whoami(self) -> int:
+        return self.osd.whoami
+
+    def is_primary(self) -> bool:
+        # first non-hole in the acting set is primary (EC acting sets
+        # keep -1 holes to preserve shard positions)
+        for o in self.acting:
+            if o >= 0:
+                return o == self.whoami
+        return False
+
+    def acting_peers(self) -> list[int]:
+        return [o for o in self.acting if o >= 0 and o != self.whoami]
+
+    def update_mapping(self, up: list[int], acting: list[int],
+                       epoch: int) -> bool:
+        """Returns True when the interval changed (peering needed)."""
+        if up == self.up and acting == self.acting:
+            return False
+        if self.acting:
+            self.past_intervals.note_interval(
+                self.info.same_interval_since, epoch - 1, self.acting)
+        self.up = list(up)
+        self.acting = list(acting)
+        self.info.same_interval_since = epoch
+        self.state = "peering" if self.is_primary() else "stray"
+        if self._recovery_task:
+            self._recovery_task.cancel()
+            self._recovery_task = None
+        return True
+
+    # -- peering (primary drives GetInfo -> GetLog -> Activate) -------------
+    async def peer(self) -> None:
+        async with self.lock:
+            await self._peer_locked()
+
+    async def _peer_locked(self) -> None:
+        epoch = self.osd.osdmap.epoch
+        self.state = "peering"
+        self.peer_info.clear()
+        self.peer_log_entries.clear()
+        self.peer_missing.clear()
+        # GetInfo: probe current + past-interval peers that are up
+        targets = [o for o in self.past_intervals.probe_targets(self.acting)
+                   if o != self.whoami and self.osd.osd_is_up(o)]
+        replies = await self.osd.fanout_and_wait(
+            [(o, "pg_query", {"pgid": self.pgid, "epoch": epoch}, [])
+             for o in targets], collect=True, timeout=5)
+        for rep in replies:
+            osd_id = rep.data["from_osd"]
+            self.peer_info[osd_id] = PGInfo.from_dict(rep.data["info"])
+            self.peer_log_entries[osd_id] = [
+                LogEntry.from_dict(e) for e in rep.data["entries"]]
+        # GetLog: adopt the most advanced history as authoritative
+        best_osd, best_info = self.whoami, self.info
+        for osd_id, pinfo in self.peer_info.items():
+            if pinfo.last_update > best_info.last_update:
+                best_osd, best_info = osd_id, pinfo
+        if best_osd != self.whoami:
+            auth_entries = self.peer_log_entries[best_osd]
+            divergent = self.log.merge(auth_entries, best_info, self.missing)
+            self._clean_divergent(divergent)
+        # GetMissing: what does each acting peer need?
+        auth_log = self.log
+        for osd_id in self.acting_peers():
+            pinfo = self.peer_info.get(osd_id)
+            if pinfo is None:
+                continue
+            self.peer_missing[osd_id] = PGLog.proc_replica_log(
+                pinfo, self.peer_log_entries.get(osd_id, []), auth_log)
+        # Activate: ship the authoritative log to the acting set
+        self.info.last_epoch_started = epoch
+        acts = [(o, "pg_activate",
+                 {"pgid": self.pgid, "epoch": epoch,
+                  "info": self.info.to_dict(),
+                  "entries": [e.to_dict() for e in self.log.entries]}, [])
+                for o in self.acting_peers() if self.osd.osd_is_up(o)]
+        replies = await self.osd.fanout_and_wait(acts, collect=True,
+                                                 timeout=5)
+        for rep in replies:
+            osd_id = rep.data["from_osd"]
+            self.peer_missing[osd_id] = MissingSet.from_dict(
+                rep.data["missing"])
+        self.state = "active"
+        self.persist_meta()
+        if self.missing or any(self.peer_missing.values()):
+            self.kick_recovery()
+
+    def on_query(self) -> dict:
+        return {"pgid": self.pgid, "info": self.info.to_dict(),
+                "entries": [e.to_dict() for e in self.log.entries],
+                "from_osd": self.whoami}
+
+    async def on_activate(self, msg) -> dict:
+        async with self.lock:
+            auth_info = PGInfo.from_dict(msg.data["info"])
+            auth_entries = [LogEntry.from_dict(e)
+                            for e in msg.data["entries"]]
+            divergent = self.log.merge(auth_entries, auth_info,
+                                       self.missing)
+            self._clean_divergent(divergent)
+            self.info.last_epoch_started = msg.data["epoch"]
+            if not self.missing:
+                self.info.last_complete = self.info.last_update
+            self.state = "replica_active"
+            self.persist_meta()
+            return {"pgid": self.pgid, "missing": self.missing.to_dict(),
+                    "from_osd": self.whoami}
+
+    def _clean_divergent(self, divergent: list[LogEntry]) -> None:
+        """Remove objects that exist locally only because of divergent
+        (never-committed) creates."""
+        if not divergent:
+            return
+        auth_oids = {e.oid for e in self.log.entries}
+        txn = Transaction()
+        removed = set()
+        for e in divergent:
+            if (not e.prior_version and e.oid not in auth_oids
+                    and e.oid not in removed and not e.is_delete()):
+                txn.remove(self.coll, e.oid)
+                removed.add(e.oid)
+        if removed:
+            self.osd.store.queue_transaction(txn)
+
+    # -- client op execution (primary) --------------------------------------
+    async def do_op(self, msg) -> tuple[dict, list[bytes]]:
+        ops = unpack_mutations(msg.data["ops"], msg.segments)
+        oid = msg.data["oid"]
+        async with self.lock:
+            if self.state != "active" or not self.is_primary():
+                return ({"err": "ENOTPRIMARY", "state": self.state}, [])
+            n_up = sum(1 for o in self.acting if o >= 0
+                       and self.osd.osd_is_up(o))
+            if n_up < self.pool.min_size:
+                return ({"err": "EAGAIN",
+                         "detail": f"acting {n_up} < min_size "
+                                   f"{self.pool.min_size}"}, [])
+            if self.missing.is_missing(oid):
+                await self._recover_object(oid)
+            for peer, ms in self.peer_missing.items():
+                if ms.is_missing(oid) and self.osd.osd_is_up(peer):
+                    await self._push_object(peer, oid)
+            results: list[dict] = []
+            segments: list[bytes] = []
+            writes: list[dict] = []
+            for op in ops:
+                name = op["op"]
+                if name in READ_OPS:
+                    r, seg = await self._do_read_op(oid, op)
+                    if seg is not None:
+                        r["seg"] = len(segments)
+                        segments.append(seg)
+                    results.append(r)
+                elif name in WRITE_OPS:
+                    writes.append(op)
+                    results.append({"ok": True})
+                else:
+                    results.append({"err": f"EOPNOTSUPP {name}"})
+            if writes:
+                err = await self._do_writes(oid, writes)
+                if err:
+                    return ({"err": err}, [])
+            return ({"results": results,
+                     "version": self.info.last_update.to_list()}, segments)
+
+    async def _do_read_op(self, oid: str,
+                          op: dict) -> tuple[dict, bytes | None]:
+        name = op["op"]
+        exists = self.osd.store.exists(self.coll, oid) or \
+            (not isinstance(self.backend, ReplicatedBackend)
+             and await self.backend.object_size(oid) > 0)
+        if name == "list":
+            oids = [o for o in self.osd.store.list_objects(self.coll)
+                    if o != META_OID]
+            return {"ok": True, "oids": sorted(oids)}, None
+        if not exists and name != "stat":
+            return {"err": "ENOENT"}, None
+        if name == "read":
+            data = await self.backend.object_read(
+                oid, op.get("off", 0), op.get("len"))
+            return {"ok": True, "len": len(data)}, bytes(data)
+        if name == "stat":
+            if not exists:
+                return {"err": "ENOENT"}, None
+            size = await self.backend.object_size(oid)
+            return {"ok": True, "size": size}, None
+        if name == "getxattr":
+            v = self.osd.store.getattr(self.coll, oid, op["name"])
+            if v is None:
+                return {"err": "ENODATA"}, None
+            return {"ok": True}, v
+        if name == "getxattrs":
+            attrs = self.osd.store.getattrs(self.coll, oid)
+            return {"ok": True,
+                    "attrs": {k: v.hex() for k, v in attrs.items()}}, None
+        if name == "omap_get":
+            omap = self.osd.store.omap_get(self.coll, oid)
+            return {"ok": True,
+                    "omap": {k: v.hex() for k, v in omap.items()}}, None
+        return {"err": f"EOPNOTSUPP {name}"}, None
+
+    async def _do_writes(self, oid: str, ops: list[dict]) -> str | None:
+        """Resolve logical ops to offset-explicit mutations, append a log
+        entry, run the backend transaction."""
+        size = await self.backend.object_size(oid)
+        muts: list[dict] = []
+        is_delete = False
+        for op in ops:
+            name = op["op"]
+            if name == "create":
+                muts.append({"op": "create"})
+            elif name == "write":
+                data = op["data"]
+                muts.append({"op": "write", "off": op.get("off", 0),
+                             "data": data})
+                size = max(size, op.get("off", 0) + len(data))
+            elif name == "writefull":
+                data = op["data"]
+                muts.append({"op": "truncate", "size": 0})
+                muts.append({"op": "write", "off": 0, "data": data})
+                size = len(data)
+            elif name == "append":
+                data = op["data"]
+                muts.append({"op": "write", "off": size, "data": data})
+                size += len(data)
+            elif name == "truncate":
+                muts.append({"op": "truncate", "size": op["size"]})
+                size = op["size"]
+            elif name == "zero":
+                muts.append({"op": "zero", "off": op["off"],
+                             "len": op["len"]})
+            elif name == "remove":
+                muts.append({"op": "remove"})
+                is_delete = True
+                size = 0
+            elif name == "setxattr":
+                muts.append({"op": "setxattr", "name": op["name"],
+                             "value": op["value"]})
+            elif name == "rmxattr":
+                muts.append({"op": "rmxattr", "name": op["name"]})
+            elif name == "omap_set":
+                muts.append({"op": "omap_set", "kv": op["kv"]})
+            elif name == "omap_rm":
+                muts.append({"op": "omap_rm", "keys": op["keys"]})
+            elif name == "omap_clear":
+                muts.append({"op": "omap_clear"})
+        prior = self.log.last_version_of(oid) or ZERO
+        entry = LogEntry(
+            op=DELETE if is_delete else MODIFY, oid=oid,
+            version=EVersion(self.osd.osdmap.epoch,
+                             self.info.last_update.version + 1),
+            prior_version=prior, mutations=[])
+        await self.backend.submit_transaction(entry, muts)
+        return None
+
+    # -- recovery -----------------------------------------------------------
+    def kick_recovery(self) -> None:
+        if self._recovery_task is None or self._recovery_task.done():
+            self._recovery_task = asyncio.ensure_future(
+                self._recovery_loop())
+
+    def _recovery_pending(self) -> bool:
+        return bool(self.missing) or any(
+            ms and self.osd.osd_is_up(peer)
+            for peer, ms in self.peer_missing.items())
+
+    async def _recovery_loop(self) -> None:
+        """Recover until clean; transient peer failures (reboots, races)
+        back off and retry rather than abandoning recovery."""
+        try:
+            for _ in range(60):
+                if self.state != "active" or not self._recovery_pending():
+                    break
+                await self.osd.admit(OpClass.RECOVERY)
+                try:
+                    async with self.lock:
+                        for oid in list(self.missing.items):
+                            await self._recover_object(oid)
+                        for peer, ms in list(self.peer_missing.items()):
+                            if not self.osd.osd_is_up(peer):
+                                continue
+                            for oid in list(ms.items):
+                                await self._push_object(peer, oid)
+                        if not self.missing:
+                            self.info.last_complete = self.info.last_update
+                        self.persist_meta()
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    pass
+                if self._recovery_pending():
+                    await asyncio.sleep(0.5)
+        except asyncio.CancelledError:
+            pass
+
+    def _shard_of(self, osd_id: int) -> int:
+        return self.acting.index(osd_id) if osd_id in self.acting else 0
+
+    async def _recover_object(self, oid: str) -> None:
+        """Pull the authoritative copy (our shard of it) from a peer."""
+        if not self.missing.is_missing(oid):
+            return
+        need, _ = self.missing.items[oid]
+        sources = [o for o, pi in self.peer_info.items()
+                   if self.osd.osd_is_up(o)
+                   and pi.last_update >= need
+                   and not self.peer_missing.get(
+                       o, MissingSet()).is_missing(oid)]
+        if not sources:
+            return        # unfound; retried on next peering round
+        replies = await self.osd.fanout_and_wait(
+            [(sources[0], "pg_pull",
+              {"pgid": self.pgid, "oid": oid,
+               "shard": self._shard_of(self.whoami)}, [])],
+            collect=True, timeout=10)
+        if not replies or replies[0].data.get("err"):
+            return                      # source not ready; retried later
+        rep = replies[0]
+        self._apply_recovery_payload(oid, rep.data, rep.segments)
+        self.missing.items.pop(oid, None)
+        self.persist_meta()
+
+    def _apply_recovery_payload(self, oid: str, data: dict,
+                                segments: list[bytes]) -> None:
+        txn = Transaction()
+        if data.get("absent"):
+            txn.remove(self.coll, oid)
+        else:
+            buf = segments[0] if segments else b""
+            txn.remove(self.coll, oid)
+            txn.touch(self.coll, oid)
+            txn.write(self.coll, oid, 0, buf)
+            for k, v in data.get("xattrs", {}).items():
+                txn.setattr(self.coll, oid, k, bytes.fromhex(v))
+            omap = {k: bytes.fromhex(v)
+                    for k, v in data.get("omap", {}).items()}
+            if omap:
+                txn.omap_setkeys(self.coll, oid, omap)
+        self.osd.store.queue_transaction(txn)
+
+    async def on_pull(self, msg) -> tuple[dict, list[bytes]]:
+        """Serve a recovery read: reconstruct the REQUESTER's shard."""
+        oid = msg.data["oid"]
+        shard = msg.data.get("shard", 0)
+        payload = await self.backend.read_recovery_payload(oid, shard)
+        return ({"oid": oid,
+                 "absent": payload.get("absent", False),
+                 "xattrs": {k: v.hex()
+                            for k, v in payload["xattrs"].items()},
+                 "omap": {k: v.hex()
+                          for k, v in payload["omap"].items()}},
+                [payload["data"]])
+
+    async def _push_object(self, peer: int, oid: str) -> None:
+        ms = self.peer_missing.get(peer)
+        if ms is None or not ms.is_missing(oid):
+            return
+        payload = await self.backend.read_recovery_payload(
+            oid, self._shard_of(peer))
+        replies = await self.osd.fanout_and_wait(
+            [(peer, "pg_push",
+              {"pgid": self.pgid, "oid": oid,
+               "absent": payload.get("absent", False),
+               "xattrs": {k: v.hex()
+                          for k, v in payload["xattrs"].items()},
+               "omap": {k: v.hex() for k, v in payload["omap"].items()}},
+              [payload["data"]])], collect=True, timeout=10)
+        if not replies or replies[0].data.get("err"):
+            return                      # peer not ready; retried later
+        ms.items.pop(oid, None)
+
+    async def on_push(self, msg) -> dict:
+        async with self.lock:
+            oid = msg.data["oid"]
+            self._apply_recovery_payload(oid, msg.data, msg.segments)
+            self.missing.items.pop(oid, None)
+            if not self.missing:
+                self.info.last_complete = self.info.last_update
+            self.persist_meta()
+            return {"pgid": self.pgid, "oid": oid,
+                    "from_osd": self.whoami}

@@ -99,17 +99,19 @@ class PGLog:
         return self.tail
 
     def merge(self, auth_entries: list[LogEntry], auth_info: PGInfo,
-              missing: MissingSet) -> None:
+              missing: MissingSet) -> list[LogEntry]:
         """Fold the authoritative log into ours (PGLog.h:1247 merge_log).
 
         Find the newest entry both logs agree on; local entries past it
         are divergent (they never committed cluster-wide) and are
         rewound; auth entries past it are appended and their objects
-        marked missing until recovered.
+        marked missing until recovered.  Returns the divergent entries
+        so the PG can clean up objects they created.
         """
         lu = self._last_common(auth_entries, auth_info.log_tail)
+        divergent: list[LogEntry] = []
         if lu < self.head:
-            self.rewind_divergent(lu, missing)
+            divergent = self.rewind_divergent(lu, missing)
         for e in auth_entries:
             if e.version <= self.head:
                 continue
@@ -120,6 +122,7 @@ class PGLog:
                 missing.add(e.oid, need=e.version, have=e.prior_version)
         if self.tail < auth_info.log_tail and not self.entries:
             self.tail = auth_info.log_tail
+        return divergent
 
     @staticmethod
     def proc_replica_log(replica_info: PGInfo, replica_entries: list[LogEntry],

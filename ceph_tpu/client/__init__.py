@@ -1,0 +1,12 @@
+"""Client layer: Objecter (op engine) + librados-shaped API.
+
+The client computes placement locally from its own OSDMap copy — no
+central metadata service — exactly the property the reference's client
+stack is built around (doc/architecture.rst:53-55, Objecter._calc_target
+src/osdc/Objecter.cc:2783).
+"""
+
+from .objecter import Objecter
+from .rados import Rados, IoCtx, RadosError
+
+__all__ = ["Objecter", "Rados", "IoCtx", "RadosError"]

@@ -1,0 +1,169 @@
+"""Objecter: client-side op engine with resend-on-map-change.
+
+Mirrors src/osdc/Objecter.cc: _calc_target (:2783) computes
+object -> PG -> primary OSD from the client's OSDMap; ops that land on
+a stale primary (ENOTPRIMARY / EAGAIN / timeout) are re-targeted
+against the refreshed map and resent — the client rides out failover
+without the application noticing (:2866 pg_to_up_acting_osds and the
+resend-on-epoch-change machinery around op_submit).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+
+from ..mon.osdmap import OSDMap, Incremental
+from ..msg import Message, Messenger
+from ..osd.backend import pack_mutations
+
+RETRYABLE = {"ENOTPRIMARY", "EAGAIN", "ENXIO no such pg"}
+
+
+class ObjecterError(Exception):
+    pass
+
+
+class Objecter:
+    def __init__(self, name: str = "client.objecter",
+                 secret: bytes | None = None) -> None:
+        self.msgr = Messenger(name, secret=secret)
+        self.osdmap = OSDMap()
+        self.mon_addr: tuple[str, int] | None = None
+        self._tid = itertools.count(1)
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._cmd_waiters: dict[int, asyncio.Future] = {}
+        self._refresh_tasks: set[asyncio.Task] = set()
+        self.msgr.add_dispatcher(self._dispatch)
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self, mon_addr: tuple[str, int]) -> None:
+        self.mon_addr = tuple(mon_addr)
+        await self.msgr.bind()
+        await self._refresh_map()
+
+    async def shutdown(self) -> None:
+        await self.msgr.shutdown()
+
+    async def _refresh_map(self, timeout: float = 10) -> None:
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def d(conn, msg):
+            if msg.type == "osdmap_full":
+                await q.put(msg.data["map"])
+
+        self.msgr.add_dispatcher(d)
+        try:
+            await self.msgr.send(self.mon_addr, "mon.0",
+                                 Message("sub_osdmap", {}))
+            self.osdmap = OSDMap.from_dict(
+                await asyncio.wait_for(q.get(), timeout))
+        finally:
+            self.msgr.dispatchers.remove(d)
+
+    # -- dispatch -----------------------------------------------------------
+    async def _dispatch(self, conn, msg: Message) -> None:
+        if msg.type == "osd_op_reply":
+            fut = self._waiters.pop(msg.data.get("tid"), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+        elif msg.type == "osdmap_inc":
+            inc = Incremental.from_dict(msg.data["inc"])
+            if inc.epoch == self.osdmap.epoch + 1:
+                self.osdmap.apply_incremental(inc)
+            elif inc.epoch > self.osdmap.epoch:
+                t = asyncio.ensure_future(self._guarded_refresh())
+                self._refresh_tasks.add(t)
+                t.add_done_callback(self._refresh_tasks.discard)
+        elif msg.type == "mon_command_reply":
+            fut = self._cmd_waiters.pop(msg.data.get("tid"), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg.data)
+
+    async def _guarded_refresh(self) -> None:
+        try:
+            await self._refresh_map(timeout=5)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass     # next op's retry path refreshes again
+
+    # -- placement ----------------------------------------------------------
+    def calc_target(self, pool_id: int, oid: str, nspace: str = "",
+                    ps: int | None = None) -> tuple[str, int | None]:
+        """(pgid, primary osd) for an object — Objecter.cc:2783.
+
+        Pass ``ps`` to target a specific PG (pgls-style ops that
+        address a placement group, not an object).
+        """
+        if ps is None:
+            _, ps = self.osdmap.object_to_pg(pool_id, oid, nspace)
+        up = self.osdmap.pg_to_up_acting_osds(pool_id, ps)
+        return self.osdmap.pg_name(pool_id, ps), self.osdmap.pg_primary(up)
+
+    # -- op submission ------------------------------------------------------
+    async def op_submit(self, pool_id: int, oid: str, ops: list[dict],
+                        nspace: str = "", timeout: float = 30,
+                        attempt_timeout: float = 5,
+                        ps: int | None = None) -> Message:
+        """Run ops on the object's primary, retrying through map churn."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        last_err = None
+        while loop.time() < deadline:
+            pgid, primary = self.calc_target(pool_id, oid, nspace, ps=ps)
+            if primary is None:
+                await self._pause_and_refresh()
+                continue
+            info = self.osdmap.osds.get(primary)
+            if info is None or info.addr is None:
+                await self._pause_and_refresh()
+                continue
+            tid = next(self._tid)
+            fut = loop.create_future()
+            self._waiters[tid] = fut
+            meta, segs = pack_mutations(ops)
+            try:
+                await self.msgr.send(
+                    tuple(info.addr), f"osd.{primary}",
+                    Message("osd_op", {"pgid": pgid, "oid": oid,
+                                       "ops": meta, "tid": tid},
+                            segments=segs))
+                reply = await asyncio.wait_for(
+                    fut, min(attempt_timeout, deadline - loop.time()))
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                self._waiters.pop(tid, None)
+                last_err = e
+                await self._pause_and_refresh()
+                continue
+            err = reply.data.get("err")
+            if err in RETRYABLE:
+                last_err = ObjecterError(err)
+                await self._pause_and_refresh()
+                continue
+            return reply
+        raise ObjecterError(
+            f"op on {oid} timed out after {timeout}s: {last_err!r}")
+
+    async def _pause_and_refresh(self) -> None:
+        await asyncio.sleep(0.25)
+        try:
+            await self._refresh_map(timeout=5)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+
+    # -- mon commands -------------------------------------------------------
+    async def mon_command(self, cmd: str, args: dict | None = None,
+                          timeout: float = 15) -> dict | list | int | str:
+        tid = next(self._tid)
+        fut = asyncio.get_event_loop().create_future()
+        self._cmd_waiters[tid] = fut
+        try:
+            await self.msgr.send(
+                self.mon_addr, "mon.0",
+                Message("mon_command", {"cmd": cmd, "args": args or {},
+                                        "tid": tid}))
+            data = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._cmd_waiters.pop(tid, None)
+        if not data["ok"]:
+            raise ObjecterError(data["error"])
+        return data["result"]

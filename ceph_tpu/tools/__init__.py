@@ -1,0 +1,12 @@
+"""CLI tools: rados/ceph clients, ec_bench, vstart launcher."""
+
+
+def parse_addr(s: str) -> tuple[str, int]:
+    """'host:port' -> (host, port); bare host gets the default port."""
+    host, sep, port = s.rpartition(":")
+    if not sep:
+        return (s or "127.0.0.1", 6789)
+    try:
+        return (host or "127.0.0.1", int(port))
+    except ValueError:
+        raise ValueError(f"bad monitor address {s!r}; want HOST:PORT")

@@ -1,0 +1,128 @@
+"""``ceph``-style admin CLI: status, osd tree/dump, pool and EC-profile
+management — the monitor command surface (src/ceph.in + MonCommands.h
+analog).  Usage: python -m ceph_tpu.tools.ceph_cli -m HOST:PORT <cmd...>
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from . import parse_addr
+from ..client import Rados, RadosError
+
+
+async def _run(args) -> int:
+    rados = Rados(parse_addr(args.mon), name="client.ceph-cli")
+    try:
+        await rados.connect()
+    except (ConnectionError, OSError, TimeoutError) as e:
+        print(f"error: cannot reach monitor at {args.mon}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        words = args.words
+        cmd, cargs = _parse_command(words)
+        result = await rados.mon_command(cmd, cargs)
+        if args.format == "json":
+            print(json.dumps(result, indent=2, default=str))
+        else:
+            _render(cmd, result)
+        return 0
+    except (RadosError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await rados.shutdown()
+
+
+def _want(words: list[str], n: int, usage: str) -> None:
+    if len(words) < n:
+        raise ValueError(f"usage: ceph {usage}")
+
+
+def _parse_command(words: list[str]) -> tuple[str, dict]:
+    """Map CLI words onto monitor commands (MonCommands.h style)."""
+    joined = " ".join(words)
+    if joined == "status":
+        return "status", {}
+    if joined == "osd tree":
+        return "osd tree", {}
+    if joined == "osd dump":
+        return "osd dump", {}
+    if joined == "osd pool ls":
+        return "osd pool ls", {}
+    if words[:3] == ["osd", "pool", "create"]:
+        _want(words, 4, "osd pool create <name> [pg_num] "
+                        "[replicated|erasure [profile]]")
+        args = {"name": words[3]}
+        if len(words) > 4:
+            args["pg_num"] = int(words[4])
+        rest = words[5:]
+        if rest and rest[0] in ("replicated", "erasure"):
+            args["type"] = rest[0]
+            if rest[0] == "erasure" and len(rest) > 1:
+                args["erasure_code_profile"] = rest[1]
+        return "osd pool create", args
+    if words[:3] == ["osd", "pool", "rm"]:
+        _want(words, 4, "osd pool rm <name>")
+        return "osd pool rm", {"name": words[3]}
+    if words[:2] == ["osd", "out"]:
+        _want(words, 3, "osd out <id>")
+        return "osd out", {"osd_id": int(words[2])}
+    if words[:2] == ["osd", "in"]:
+        _want(words, 3, "osd in <id>")
+        return "osd in", {"osd_id": int(words[2])}
+    if words[:3] == ["osd", "erasure-code-profile", "ls"]:
+        return "osd erasure-code-profile ls", {}
+    if words[:3] == ["osd", "erasure-code-profile", "get"]:
+        _want(words, 4, "osd erasure-code-profile get <name>")
+        return "osd erasure-code-profile get", {"name": words[3]}
+    if words[:3] == ["osd", "erasure-code-profile", "set"]:
+        _want(words, 4, "osd erasure-code-profile set <name> [k=v ...]")
+        profile = {}
+        for kv in words[4:]:
+            k, _, v = kv.partition("=")
+            profile[k] = v
+        return ("osd erasure-code-profile set",
+                {"name": words[3], "profile": profile})
+    raise ValueError(f"unknown command: {joined}")
+
+
+def _render(cmd: str, result) -> None:
+    if cmd == "status":
+        print(f"  cluster epoch {result['epoch']}")
+        print(f"  health: {result['health']}")
+        print(f"  osd: {result['num_osds']} osds: "
+              f"{result['num_up']} up, {result['num_in']} in")
+        print(f"  pools: {result['pools']}")
+    elif cmd == "osd tree":
+        print(f"{'ID':>4} {'TYPE':<6} {'NAME':<12} {'STATUS':<8} WEIGHT")
+        for row in result:
+            if row["type"] == "host":
+                print(f"{'':>4} {'host':<6} {row['name']:<12}")
+            else:
+                status = "up" if row["up"] else "down"
+                print(f"{row['id']:>4} {'osd':<6} osd.{row['id']:<8} "
+                      f"{status:<8} {row['weight']/65536:.4f}")
+    elif isinstance(result, (list, tuple)):
+        for item in result:
+            print(item)
+    else:
+        print(json.dumps(result, indent=2, default=str))
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="ceph")
+    p.add_argument("-m", "--mon", default="127.0.0.1:6789")
+    p.add_argument("-f", "--format", default="plain",
+                   choices=["plain", "json"])
+    p.add_argument("words", nargs="+")
+    args = p.parse_args(argv)
+    return asyncio.run(_run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

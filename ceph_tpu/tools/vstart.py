@@ -1,0 +1,79 @@
+"""vstart-style single-host cluster launcher (src/vstart.sh analog).
+
+Boots one monitor + N OSDs in one asyncio process and serves until
+SIGINT/SIGTERM.  With --store-dir, OSDs use SQLite-backed DBStores so
+the cluster survives restarts (crash-recovery via WAL).
+
+    python -m ceph_tpu.tools.vstart --osds 3 --mon-port 6789
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+from ..mon import Monitor
+from ..os.store import DBStore, MemStore
+from ..osd import OSD
+
+
+async def run_cluster(args) -> None:
+    mon = Monitor(rank=0,
+                  store_path=(os.path.join(args.store_dir, "mon.db")
+                              if args.store_dir else ":memory:"),
+                  config={"mon_osd_min_down_reporters":
+                          args.min_down_reporters})
+    addr = await mon.start(port=args.mon_port)
+    mon.peer_addrs = [addr]
+    print(f"mon.0 at {addr[0]}:{addr[1]}", flush=True)
+    osds = []
+    for i in range(args.osds):
+        if args.store_dir:
+            store = DBStore(os.path.join(args.store_dir, f"osd{i}.db"))
+        else:
+            store = MemStore()
+        osd = OSD(host=f"host{i % args.hosts}", store=store,
+                  config={"osd_heartbeat_interval": 0.5,
+                          "osd_heartbeat_grace": 4.0})
+        wid = await osd.start(addr)
+        print(f"osd.{wid} up ({'db' if args.store_dir else 'mem'} store, "
+              f"host{i % args.hosts})", flush=True)
+        osds.append(osd)
+    print(f"cluster ready: 1 mon, {len(osds)} osds -- "
+          f"rados -m {addr[0]}:{addr[1]} lspools", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("shutting down...", flush=True)
+    for osd in osds:
+        await osd.stop()
+    await mon.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="vstart")
+    p.add_argument("--osds", type=int, default=3)
+    p.add_argument("--hosts", type=int, default=3,
+                   help="spread OSDs over N crush hosts")
+    p.add_argument("--mon-port", type=int, default=6789)
+    p.add_argument("--store-dir", default=None,
+                   help="directory for durable SQLite stores")
+    p.add_argument("--min-down-reporters", type=int, default=2)
+    args = p.parse_args(argv)
+    if args.store_dir:
+        os.makedirs(args.store_dir, exist_ok=True)
+    try:
+        asyncio.run(run_cluster(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,7 @@ class Objecter:
         self.osdmap = OSDMap()
         self.mon_addr: tuple[str, int] | None = None
         self._tid = itertools.count(1)
+        self._reqid_serial = itertools.count(1)
         self._waiters: dict[int, asyncio.Future] = {}
         self._cmd_waiters: dict[int, asyncio.Future] = {}
         self._refresh_tasks: set[asyncio.Task] = set()
@@ -56,8 +57,12 @@ class Objecter:
         try:
             await self.msgr.send(self.mon_addr, "mon.0",
                                  Message("sub_osdmap", {}))
-            self.osdmap = OSDMap.from_dict(
+            new_map = OSDMap.from_dict(
                 await asyncio.wait_for(q.get(), timeout))
+            # a slow full-map reply must not regress past incrementals
+            # _dispatch applied while we waited
+            if new_map.epoch >= self.osdmap.epoch:
+                self.osdmap = new_map
         finally:
             self.msgr.dispatchers.remove(d)
 
@@ -108,6 +113,11 @@ class Objecter:
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout
         last_err = None
+        # reqid is stable across RESENDS of this op (unlike the per-
+        # attempt tid) so the PG can detect and absorb duplicates
+        # (osd_reqid_t semantics)
+        reqid = [f"{self.msgr.name}:{self.msgr.incarnation}",
+                 next(self._reqid_serial)]
         while loop.time() < deadline:
             pgid, primary = self.calc_target(pool_id, oid, nspace, ps=ps)
             if primary is None:
@@ -125,7 +135,8 @@ class Objecter:
                 await self.msgr.send(
                     tuple(info.addr), f"osd.{primary}",
                     Message("osd_op", {"pgid": pgid, "oid": oid,
-                                       "ops": meta, "tid": tid},
+                                       "ops": meta, "tid": tid,
+                                       "reqid": reqid},
                             segments=segs))
                 reply = await asyncio.wait_for(
                     fut, min(attempt_timeout, deadline - loop.time()))

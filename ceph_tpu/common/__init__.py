@@ -1,0 +1,19 @@
+"""Common runtime: typed config, perf counters, admin socket, logging.
+
+The src/common analog: ConfigProxy with observers
+(src/common/config_proxy.h), PerfCounters (src/common/perf_counters.cc),
+per-daemon admin socket (src/common/admin_socket.cc), and the dout
+ring-buffer logger (src/log/Log.cc).
+"""
+
+from .config import Option, ConfigProxy, OPT_INT, OPT_FLOAT, OPT_STR, \
+    OPT_BOOL
+from .perf import PerfCounters, PerfCountersCollection
+from .admin_socket import AdminSocket
+from .log import Logger, log_context
+
+__all__ = [
+    "Option", "ConfigProxy", "OPT_INT", "OPT_FLOAT", "OPT_STR",
+    "OPT_BOOL", "PerfCounters", "PerfCountersCollection", "AdminSocket",
+    "Logger", "log_context",
+]

@@ -1,0 +1,169 @@
+"""Typed configuration registry with change observers.
+
+The src/common/options + ConfigProxy analog: options are declared in a
+typed schema (name/type/level/default/min/max/enum/desc — the shape of
+src/common/options/*.yaml.in), values layer defaults < file < env <
+runtime overrides, and observers get notified on runtime changes
+(md_config_obs_t, src/common/config_proxy.h:15-180).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+OPT_INT = "int"
+OPT_FLOAT = "float"
+OPT_STR = "str"
+OPT_BOOL = "bool"
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+_CASTERS = {
+    OPT_INT: int,
+    OPT_FLOAT: float,
+    OPT_STR: str,
+    OPT_BOOL: lambda v: (v if isinstance(v, bool)
+                         else str(v).lower() in ("1", "true", "yes", "on")),
+}
+
+
+@dataclass
+class Option:
+    name: str
+    type: str
+    default: Any
+    desc: str = ""
+    level: str = LEVEL_ADVANCED
+    min: float | None = None
+    max: float | None = None
+    enum_values: list[str] = field(default_factory=list)
+
+    def cast(self, value: Any) -> Any:
+        try:
+            v = _CASTERS[self.type](value)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"{self.name}={value!r} is not a valid {self.type}")
+        if self.min is not None and v < self.min:
+            raise ValueError(f"{self.name}={v} below min {self.min}")
+        if self.max is not None and v > self.max:
+            raise ValueError(f"{self.name}={v} above max {self.max}")
+        if self.enum_values and v not in self.enum_values:
+            raise ValueError(
+                f"{self.name}={v!r} not in {self.enum_values}")
+        return v
+
+
+# the schema the daemons share (subset of the reference's option set,
+# same names where the concept carries over)
+DEFAULT_SCHEMA: list[Option] = [
+    Option("osd_heartbeat_interval", OPT_FLOAT, 0.5,
+           "seconds between peer pings", min=0.01),
+    Option("osd_heartbeat_grace", OPT_FLOAT, 4.0,
+           "seconds of silence before reporting a peer down", min=0.1),
+    Option("osd_pool_default_size", OPT_INT, 3,
+           "replica count for new pools", min=1),
+    Option("osd_pool_default_min_size", OPT_INT, 2,
+           "min replicas to accept writes", min=1),
+    Option("osd_pool_default_pg_num", OPT_INT, 32,
+           "pg count for new pools", min=1),
+    Option("osd_recovery_max_active", OPT_INT, 3,
+           "max concurrent recovery ops per OSD", min=1),
+    Option("osd_client_op_priority", OPT_INT, 63, "client op priority"),
+    Option("osd_scrub_interval", OPT_FLOAT, 60.0,
+           "seconds between periodic scrubs", min=0.0),
+    Option("mon_osd_min_down_reporters", OPT_INT, 2,
+           "distinct reporters before marking an osd down", min=1),
+    Option("mon_osd_down_out_interval", OPT_FLOAT, 600.0,
+           "seconds down before auto-out", min=0.0),
+    Option("mon_lease", OPT_FLOAT, 5.0, "paxos leader lease seconds"),
+    Option("osd_erasure_code_plugins", OPT_STR, "tpu isa jerasure",
+           "plugins preloaded at daemon start"),
+    Option("osd_pool_default_erasure_code_profile", OPT_STR,
+           "plugin=tpu k=2 m=1 technique=reed_sol_van",
+           "default EC profile"),
+    Option("debug_osd", OPT_INT, 1, "osd log verbosity", min=0, max=20,
+           level=LEVEL_DEV),
+    Option("debug_mon", OPT_INT, 1, "mon log verbosity", min=0, max=20,
+           level=LEVEL_DEV),
+    Option("debug_ms", OPT_INT, 0, "messenger log verbosity", min=0,
+           max=20, level=LEVEL_DEV),
+    Option("log_max_recent", OPT_INT, 1000,
+           "ring-buffered log entries kept for crash dump", min=0),
+]
+
+
+class ConfigProxy:
+    """Layered typed config: defaults < file < env < runtime set()."""
+
+    ENV_PREFIX = "CEPH_TPU_"
+
+    def __init__(self, schema: list[Option] | None = None,
+                 conf_file: str | None = None,
+                 values: dict | None = None,
+                 read_env: bool = True) -> None:
+        self.schema: dict[str, Option] = {
+            o.name: o for o in (schema or DEFAULT_SCHEMA)}
+        self._values: dict[str, Any] = {}
+        self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
+        if conf_file and os.path.exists(conf_file):
+            self._load_file(conf_file)
+        if read_env:
+            self._load_env()
+        for k, v in (values or {}).items():
+            self.set(k, v, notify=False)
+
+    def _load_file(self, path: str) -> None:
+        with open(path) as f:
+            data = json.load(f)
+        for k, v in data.items():
+            if k in self.schema:
+                self._values[k] = self.schema[k].cast(v)
+
+    def _load_env(self) -> None:
+        for name, opt in self.schema.items():
+            env = os.environ.get(self.ENV_PREFIX + name.upper())
+            if env is not None:
+                self._values[name] = opt.cast(env)
+
+    # -- access -------------------------------------------------------------
+    def get(self, name: str) -> Any:
+        opt = self.schema.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name}")
+        return self._values.get(name, opt.default)
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any, notify: bool = True) -> None:
+        opt = self.schema.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name}")
+        v = opt.cast(value)
+        self._values[name] = v
+        if notify:
+            for cb in self._observers.get(name, []):
+                cb(name, v)
+
+    def add_observer(self, name: str,
+                     cb: Callable[[str, Any], None]) -> None:
+        if name not in self.schema:
+            raise KeyError(f"unknown option {name}")
+        self._observers.setdefault(name, []).append(cb)
+
+    # -- introspection (`ceph config help/show` analog) ---------------------
+    def show(self) -> dict[str, Any]:
+        return {name: self.get(name) for name in sorted(self.schema)}
+
+    def describe(self, name: str) -> dict:
+        o = self.schema[name]
+        return {"name": o.name, "type": o.type, "level": o.level,
+                "default": o.default, "desc": o.desc, "min": o.min,
+                "max": o.max, "enum_values": o.enum_values,
+                "current": self.get(name)}

@@ -17,6 +17,7 @@ import sqlite3
 import time
 from collections import defaultdict
 
+from ..common import AdminSocket, PerfCountersCollection
 from ..msg import Message, Messenger
 from ..crush.types import (
     Bucket, CrushMap, CRUSH_BUCKET_STRAW2,
@@ -73,7 +74,8 @@ class MonStore:
 class Monitor:
     def __init__(self, rank: int = 0, peers: list[tuple[str, int]] | None = None,
                  store_path: str = ":memory:", secret: bytes | None = None,
-                 config: dict | None = None) -> None:
+                 config: dict | None = None,
+                 admin_socket_path: str | None = None) -> None:
         self.rank = rank
         self.peer_addrs = peers or []     # rank -> addr (incl. self slot)
         self.msgr = Messenger(f"mon.{rank}", secret=secret)
@@ -97,6 +99,11 @@ class Monitor:
         self.quorum: set[int] = {rank}
         self.accepts: dict[int, set[int]] = {}
         self._commit_waiters: dict[int, asyncio.Future] = {}
+        # observability (Paxos registers PerfCounters too, Paxos.cc:117)
+        self.perf = PerfCountersCollection()
+        self.perf_paxos = self.perf.create("paxos")
+        self.admin_socket: AdminSocket | None = None
+        self._admin_socket_path = admin_socket_path
         self.msgr.add_dispatcher(self._dispatch)
         self._replay()
 
@@ -116,9 +123,28 @@ class Monitor:
             self.peer_addrs.append(None)
         self.peer_addrs[self.rank] = addr
         self._tick_task = asyncio.ensure_future(self._tick_loop())
+        if self._admin_socket_path:
+            self.admin_socket = AdminSocket(self._admin_socket_path)
+
+            async def perf_dump(req):
+                return self.perf.dump()
+
+            async def mon_status(req):
+                return {"rank": self.rank, "quorum": sorted(self.quorum),
+                        "leader": self.is_leader,
+                        "epoch": self.osdmap.epoch,
+                        "last_committed": self.store.last_committed()}
+
+            self.admin_socket.register("perf dump",
+                                       "dump perf counters", perf_dump)
+            self.admin_socket.register("mon_status", "monitor status",
+                                       mon_status)
+            await self.admin_socket.start()
         return addr
 
     async def stop(self) -> None:
+        if self.admin_socket is not None:
+            await self.admin_socket.stop()
         if self._tick_task:
             self._tick_task.cancel()
         await self.msgr.shutdown()
@@ -133,6 +159,12 @@ class Monitor:
     # -- proposal path ------------------------------------------------------
     async def propose(self, inc: Incremental) -> None:
         """Commit one incremental through the quorum (leader-side)."""
+        self.perf_paxos.inc("begin")
+        with self.perf_paxos.time("commit_latency"):
+            await self._propose_locked(inc)
+        self.perf_paxos.inc("commit")
+
+    async def _propose_locked(self, inc: Incremental) -> None:
         async with self._pending_lock:
             inc.epoch = self.osdmap.epoch + 1
             blob = json.dumps(inc.to_dict()).encode()

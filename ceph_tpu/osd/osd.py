@@ -14,10 +14,11 @@ import itertools
 import time
 import uuid as uuid_mod
 
+from ..common import AdminSocket, ConfigProxy, PerfCountersCollection
 from ..mon.osdmap import OSDMap, Incremental
 from ..msg import Message, Messenger
 from ..os.store import MemStore
-from .pg import PG
+from .pg import PG, WRITE_OPS
 from .scheduler import MClockScheduler, OpClass
 
 
@@ -25,7 +26,8 @@ class OSD:
     def __init__(self, uuid: str | None = None, whoami: int | None = None,
                  store=None, host: str = "host0",
                  secret: bytes | None = None,
-                 config: dict | None = None) -> None:
+                 config: dict | None = None,
+                 admin_socket_path: str | None = None) -> None:
         self.uuid = uuid or uuid_mod.uuid4().hex
         self.whoami = whoami if whoami is not None else -1
         self.host = host
@@ -35,6 +37,16 @@ class OSD:
             "osd_heartbeat_grace": 3.0,
             **(config or {}),
         }
+        # typed registry over the same values: admin-socket `config set`
+        # flows through the schema validation and back into the dict the
+        # hot paths read (ConfigProxy observer pattern)
+        from ..common.config import DEFAULT_SCHEMA
+        known = {o.name for o in DEFAULT_SCHEMA}
+        self.conf = ConfigProxy(values={
+            k: v for k, v in self.config.items() if k in known})
+        for name in known:
+            self.conf.add_observer(
+                name, lambda k, v: self.config.__setitem__(k, v))
         self.secret = secret
         self.msgr: Messenger | None = None
         self.mon_addr: tuple[str, int] | None = None
@@ -48,6 +60,13 @@ class OSD:
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
         self._rebooting = False
+        # observability (src/common/perf_counters + TrackedOp analog)
+        self.perf = PerfCountersCollection()
+        self.perf_osd = self.perf.create("osd")
+        self._inflight: dict[int, dict] = {}
+        self._op_serial = itertools.count(1)
+        self.admin_socket: AdminSocket | None = None
+        self._admin_socket_path = admin_socket_path
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self, mon_addr: tuple[str, int],
@@ -71,19 +90,67 @@ class OSD:
         full = await self._mon_request("sub_osdmap", {},
                                        reply_type="osdmap_full")
         self._apply_full_map(full["map"])
-        self._tasks = [
+        # extend, never reassign: anything registered into _tasks before
+        # this point would lose its only strong reference and get
+        # garbage-collected mid-await
+        self._tasks += [
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._sched_loop()),
         ]
+        if self._admin_socket_path:
+            self.admin_socket = AdminSocket(self._admin_socket_path)
+            self._register_admin_commands()
+            await self.admin_socket.start()
         return self.whoami
+
+    def _register_admin_commands(self) -> None:
+        sock = self.admin_socket
+
+        async def perf_dump(req):
+            return self.perf.dump()
+
+        async def status(req):
+            return {"whoami": self.whoami, "epoch": self.osdmap.epoch,
+                    "num_pgs": len(self.pgs),
+                    "pg_states": {pgid: pg.state
+                                  for pgid, pg in self.pgs.items()}}
+
+        async def ops_in_flight(req):
+            now = time.monotonic()
+            return [{"id": k, **{x: v[x] for x in ("oid", "pgid", "type")},
+                     "age": round(now - v["start"], 4)}
+                    for k, v in self._inflight.items()]
+
+        async def config_show(req):
+            return self.conf.show()
+
+        async def config_get(req):
+            return self.conf.describe(req["name"])
+
+        async def config_set(req):
+            self.conf.set(req["name"], req["value"])
+            return {req["name"]: self.conf.get(req["name"])}
+
+        sock.register("perf dump", "dump perf counters", perf_dump)
+        sock.register("status", "osd status", status)
+        sock.register("dump_ops_in_flight", "in-flight client ops",
+                      ops_in_flight)
+        sock.register("config show", "all config values", config_show)
+        sock.register("config get", "describe one option", config_get)
+        sock.register("config set", "set option (name=..., value=...)",
+                      config_set)
 
     async def stop(self) -> None:
         self._stopped = True
+        if self.admin_socket is not None:
+            await self.admin_socket.stop()
         for t in self._tasks:
             t.cancel()
         for pg in self.pgs.values():
             if pg._recovery_task:
                 pg._recovery_task.cancel()
+            if pg._peering_task:
+                pg._peering_task.cancel()
         if self.msgr:
             await self.msgr.shutdown()
         self.store.umount()
@@ -146,11 +213,7 @@ class OSD:
                     self.pgs[pgid] = pg
                 changed = pg.update_mapping(up, list(up), epoch)
                 if changed and pg.is_primary():
-                    t = asyncio.ensure_future(pg.peer())
-                    self._tasks.append(t)
-                    t.add_done_callback(
-                        lambda t: t in self._tasks
-                        and self._tasks.remove(t))
+                    pg.kick_peering()
         # drop PGs for deleted pools
         live_pools = set(self.osdmap.pools)
         for pgid in list(self.pgs):
@@ -327,12 +390,16 @@ class OSD:
     async def _heartbeat_once(self) -> None:
         now = time.monotonic()
         grace = self.config["osd_heartbeat_grace"]
-        # opportunistic recovery re-kick (a push/pull that raced a peer
-        # reboot backs off; the tick restarts it)
+        # opportunistic re-kicks: a recovery push/pull that raced a peer
+        # reboot backs off (the tick restarts it); a peering task that
+        # died leaves the PG stranded (the tick re-runs it)
         for pg in self.pgs.values():
-            if (pg.is_primary() and pg.state == "active"
-                    and pg._recovery_pending()):
+            if not pg.is_primary():
+                continue
+            if pg.state == "active" and pg._recovery_pending():
                 pg.kick_recovery()
+            elif pg.state == "peering":
+                pg.kick_peering()
         peers = [osd for osd, info in self.osdmap.osds.items()
                  if osd != self.whoami and info.up]
         await asyncio.gather(*(self._ping_one(o, now) for o in peers),
@@ -386,7 +453,26 @@ class OSD:
                 "osd_op_reply", {"tid": msg.data.get("tid"),
                                  "err": "ENXIO no such pg"}))
             return
-        data, segments = await pg.do_op(msg)
+        opid = next(self._op_serial)
+        op_names = [o.get("op") for o in msg.data.get("ops", [])]
+        self._inflight[opid] = {
+            "oid": msg.data["oid"], "pgid": msg.data["pgid"],
+            "type": "+".join(op_names), "start": time.monotonic()}
+        try:
+            with self.perf_osd.time("op_latency"):
+                data, segments = await pg.do_op(msg)
+        finally:
+            self._inflight.pop(opid, None)
+        if "err" not in data:          # rejected ops aren't throughput
+            self.perf_osd.inc("op")
+            if any(n in WRITE_OPS for n in op_names):
+                self.perf_osd.inc("op_w")
+                self.perf_osd.inc("op_in_bytes",
+                                  sum(len(s) for s in msg.segments))
+            else:
+                self.perf_osd.inc("op_r")
+                self.perf_osd.inc("op_out_bytes",
+                                  sum(len(s) for s in segments))
         data["tid"] = msg.data.get("tid")
         data["epoch"] = self.osdmap.epoch
         await conn.send(Message("osd_op_reply", data, segments=segments))
@@ -400,6 +486,7 @@ class OSD:
             entry = LogEntry.from_dict(msg.data["entry"])
             muts = unpack_mutations(msg.data["muts"], msg.segments)
             pg.backend.apply_rep_op(entry, muts)
+            self.perf_osd.inc("subop_w")
         await conn.send(Message("rep_op_reply",
                                 {"tid": msg.data.get("tid"),
                                  "from_osd": self.whoami}))
@@ -419,6 +506,7 @@ class OSD:
                                          msg.segments[n_data_segs:])
             pg.backend.apply_sub_write(
                 entry, w, msg.segments[:n_data_segs], attr_muts)
+            self.perf_osd.inc("subop_w")
         await conn.send(Message("ec_subop_write_reply",
                                 {"tid": msg.data.get("tid"),
                                  "from_osd": self.whoami}))
@@ -500,6 +588,7 @@ class OSD:
                                      "err": "ENXIO"}))
             return
         data = await pg.on_push(msg)
+        self.perf_osd.inc("recovery_ops")
         data["tid"] = msg.data.get("tid")
         await conn.send(Message("pg_push_reply", data))
 

@@ -53,6 +53,8 @@ class PG:
         self.state = "initial"
         self.lock = asyncio.Lock()
         self._recovery_task: asyncio.Task | None = None
+        self._peering_task: asyncio.Task | None = None
+        self._completed_reqids: dict[tuple[str, int], EVersion] = {}
         if not self.osd.store.collection_exists(self.coll):
             txn = Transaction()
             txn.create_collection(self.coll)
@@ -68,6 +70,7 @@ class PG:
             self.info = PGInfo.from_dict(json.loads(omap["info"]))
         if "log" in omap:
             self.log = PGLog.from_dict(json.loads(omap["log"]))
+            self._reindex_reqids()
         if "missing" in omap:
             self.missing = MissingSet.from_dict(json.loads(omap["missing"]))
         if "past_intervals" in omap:
@@ -97,12 +100,22 @@ class PG:
         PGLog persisted via ObjectStore::Transaction)."""
         if entry.version > self.log.head:
             self.log.add(entry)
+            if entry.reqid is not None:
+                self._completed_reqids[tuple(entry.reqid)] = entry.version
             if len(self.log.entries) > LOG_CAP:
                 self.log.trim(self.log.entries[-LOG_CAP].version)
+                self._reindex_reqids()
             self.info.last_update = entry.version
             if not self.missing:
                 self.info.last_complete = entry.version
         self.persist_meta(txn)
+
+    def _reindex_reqids(self) -> None:
+        """Rebuild the dup-detection index from the trimmed log
+        (pg_log_dup_t analog: dedup window == log window)."""
+        self._completed_reqids = {
+            tuple(e.reqid): e.version
+            for e in self.log.entries if e.reqid is not None}
 
     # -- role / mapping -----------------------------------------------------
     @property
@@ -135,12 +148,34 @@ class PG:
         if self._recovery_task:
             self._recovery_task.cancel()
             self._recovery_task = None
+        if self._peering_task:
+            self._peering_task.cancel()
+            self._peering_task = None
         return True
 
     # -- peering (primary drives GetInfo -> GetLog -> Activate) -------------
+    def kick_peering(self) -> None:
+        """Own the peering task on the PG (strong ref + retry)."""
+        if self._peering_task is None or self._peering_task.done():
+            self._peering_task = asyncio.ensure_future(self.peer())
+
     async def peer(self) -> None:
-        async with self.lock:
-            await self._peer_locked()
+        """Run peering to completion; transient failures retry rather
+        than stranding the PG in 'peering' forever."""
+        epoch = self.osd.osdmap.epoch
+        for _ in range(5):
+            if (not self.is_primary()
+                    or self.osd.osdmap.epoch != epoch):
+                return       # a newer interval owns peering now
+            try:
+                async with self.lock:
+                    await self._peer_locked()
+                return
+            except asyncio.CancelledError:
+                raise
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    KeyError, ValueError):
+                await asyncio.sleep(0.5)
 
     async def _peer_locked(self) -> None:
         epoch = self.osd.osdmap.epoch
@@ -168,6 +203,7 @@ class PG:
             auth_entries = self.peer_log_entries[best_osd]
             divergent = self.log.merge(auth_entries, best_info, self.missing)
             self._clean_divergent(divergent)
+            self._reindex_reqids()
         # GetMissing: what does each acting peer need?
         auth_log = self.log
         for osd_id in self.acting_peers():
@@ -207,6 +243,7 @@ class PG:
             divergent = self.log.merge(auth_entries, auth_info,
                                        self.missing)
             self._clean_divergent(divergent)
+            self._reindex_reqids()
             self.info.last_epoch_started = msg.data["epoch"]
             if not self.missing:
                 self.info.last_complete = self.info.last_update
@@ -235,9 +272,17 @@ class PG:
     async def do_op(self, msg) -> tuple[dict, list[bytes]]:
         ops = unpack_mutations(msg.data["ops"], msg.segments)
         oid = msg.data["oid"]
+        rq = msg.data.get("reqid")
+        reqid = (rq[0], rq[1]) if rq else None
         async with self.lock:
             if self.state != "active" or not self.is_primary():
                 return ({"err": "ENOTPRIMARY", "state": self.state}, [])
+            if reqid is not None and reqid in self._completed_reqids:
+                # the client resent a write we already applied (its
+                # reply was lost): acknowledge without re-applying
+                v = self._completed_reqids[reqid]
+                return ({"results": [{"ok": True} for _ in ops],
+                         "version": v.to_list(), "dup": True}, [])
             n_up = sum(1 for o in self.acting if o >= 0
                        and self.osd.osd_is_up(o))
             if n_up < self.pool.min_size:
@@ -266,7 +311,7 @@ class PG:
                 else:
                     results.append({"err": f"EOPNOTSUPP {name}"})
             if writes:
-                err = await self._do_writes(oid, writes)
+                err = await self._do_writes(oid, writes, reqid)
                 if err:
                     return ({"err": err}, [])
             return ({"results": results,
@@ -308,7 +353,8 @@ class PG:
                     "omap": {k: v.hex() for k, v in omap.items()}}, None
         return {"err": f"EOPNOTSUPP {name}"}, None
 
-    async def _do_writes(self, oid: str, ops: list[dict]) -> str | None:
+    async def _do_writes(self, oid: str, ops: list[dict],
+                         reqid: tuple[str, int] | None = None) -> str | None:
         """Resolve logical ops to offset-explicit mutations, append a log
         entry, run the backend transaction."""
         size = await self.backend.object_size(oid)
@@ -358,7 +404,7 @@ class PG:
             op=DELETE if is_delete else MODIFY, oid=oid,
             version=EVersion(self.osd.osdmap.epoch,
                              self.info.last_update.version + 1),
-            prior_version=prior, mutations=[])
+            prior_version=prior, mutations=[], reqid=reqid)
         await self.backend.submit_transaction(entry, muts)
         return None
 

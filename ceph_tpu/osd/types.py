@@ -45,13 +45,19 @@ ERROR = "error"
 
 @dataclass
 class LogEntry:
-    """One mutation in a PG's op log."""
+    """One mutation in a PG's op log.
+
+    ``reqid`` identifies the client request that produced the entry
+    (osd_reqid_t analog) — the substrate of duplicate-op detection when
+    a client resends a write whose reply was lost.
+    """
 
     op: str
     oid: str
     version: EVersion
     prior_version: EVersion = ZERO
     mutations: list[dict[str, Any]] = field(default_factory=list)
+    reqid: tuple[str, int] | None = None
 
     def is_delete(self) -> bool:
         return self.op == DELETE
@@ -60,14 +66,17 @@ class LogEntry:
         return {"op": self.op, "oid": self.oid,
                 "v": self.version.to_list(),
                 "pv": self.prior_version.to_list(),
-                "m": self.mutations}
+                "m": self.mutations,
+                "rq": list(self.reqid) if self.reqid else None}
 
     @classmethod
     def from_dict(cls, d: dict) -> "LogEntry":
+        rq = d.get("rq")
         return cls(op=d["op"], oid=d["oid"],
                    version=EVersion.from_list(d["v"]),
                    prior_version=EVersion.from_list(d["pv"]),
-                   mutations=list(d.get("m", [])))
+                   mutations=list(d.get("m", [])),
+                   reqid=(rq[0], rq[1]) if rq else None)
 
 
 @dataclass

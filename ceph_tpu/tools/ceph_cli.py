@@ -114,6 +114,23 @@ def _render(cmd: str, result) -> None:
         print(json.dumps(result, indent=2, default=str))
 
 
+async def _run_daemon_command(sock_path: str, words: list[str]) -> int:
+    """`ceph daemon <sock> <cmd...>` — admin-socket introspection."""
+    from ..common.admin_socket import admin_command
+    kwargs = {}
+    if words[:2] == ["config", "get"] and len(words) >= 3:
+        words, kwargs = words[:2], {"name": words[2]}
+    elif words[:2] == ["config", "set"] and len(words) >= 4:
+        words, kwargs = words[:2], {"name": words[2], "value": words[3]}
+    try:
+        result = await admin_command(sock_path, " ".join(words), **kwargs)
+        print(json.dumps(result, indent=2, default=str))
+        return 0
+    except (RuntimeError, ConnectionError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="ceph")
     p.add_argument("-m", "--mon", default="127.0.0.1:6789")
@@ -121,6 +138,13 @@ def main(argv: list[str] | None = None) -> int:
                    choices=["plain", "json"])
     p.add_argument("words", nargs="+")
     args = p.parse_args(argv)
+    if args.words[0] == "daemon":
+        if len(args.words) < 3:
+            print("usage: ceph daemon <socket-path> <command...>",
+                  file=sys.stderr)
+            return 2
+        return asyncio.run(
+            _run_daemon_command(args.words[1], args.words[2:]))
     return asyncio.run(_run(args))
 
 

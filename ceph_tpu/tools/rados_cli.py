@@ -114,7 +114,8 @@ async def _dispatch(rados: Rados, args) -> int:
 
 
 async def _bench(ioctx, args) -> int:
-    """radosbench-style throughput loop (write then read back)."""
+    """radosbench-style write-throughput loop (objects cleaned up
+    afterwards)."""
     size = args.obj_size
     payload = b"\xa5" * size
     t0 = time.perf_counter()

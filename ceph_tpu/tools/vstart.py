@@ -21,11 +21,15 @@ from ..osd import OSD
 
 
 async def run_cluster(args) -> None:
+    asok_dir = args.asok_dir or args.store_dir
     mon = Monitor(rank=0,
                   store_path=(os.path.join(args.store_dir, "mon.db")
                               if args.store_dir else ":memory:"),
                   config={"mon_osd_min_down_reporters":
-                          args.min_down_reporters})
+                          args.min_down_reporters},
+                  admin_socket_path=(
+                      os.path.join(asok_dir, "mon.0.asok")
+                      if asok_dir else None))
     addr = await mon.start(port=args.mon_port)
     mon.peer_addrs = [addr]
     print(f"mon.0 at {addr[0]}:{addr[1]}", flush=True)
@@ -37,7 +41,10 @@ async def run_cluster(args) -> None:
             store = MemStore()
         osd = OSD(host=f"host{i % args.hosts}", store=store,
                   config={"osd_heartbeat_interval": 0.5,
-                          "osd_heartbeat_grace": 4.0})
+                          "osd_heartbeat_grace": 4.0},
+                  admin_socket_path=(
+                      os.path.join(asok_dir, f"osd.{i}.asok")
+                      if asok_dir else None))
         wid = await osd.start(addr)
         print(f"osd.{wid} up ({'db' if args.store_dir else 'mem'} store, "
               f"host{i % args.hosts})", flush=True)
@@ -64,6 +71,8 @@ def main(argv=None) -> int:
     p.add_argument("--mon-port", type=int, default=6789)
     p.add_argument("--store-dir", default=None,
                    help="directory for durable SQLite stores")
+    p.add_argument("--asok-dir", default=None,
+                   help="directory for admin sockets (default store-dir)")
     p.add_argument("--min-down-reporters", type=int, default=2)
     args = p.parse_args(argv)
     if args.store_dir:

@@ -215,6 +215,52 @@ def test_ec_pool_io():
     run(main())
 
 
+def test_resent_write_deduped_by_reqid():
+    """A resent write (lost reply) must not double-apply — osd_reqid
+    dedup via the PG log."""
+    async def main():
+        c = await make_cluster(3)
+        try:
+            await c.command("osd pool create",
+                            {"name": "rbd", "pg_num": 4, "size": 3,
+                             "min_size": 2})
+            pgid, primary, _ = c.target_for("rbd", "dup-obj")
+            # wait for the pg to activate
+            await c.osd_op("rbd", "dup-obj", [
+                {"op": "write", "off": 0, "data": b"base"}])
+            q = asyncio.Queue()
+
+            async def d(conn, msg):
+                if msg.type == "osd_op_reply":
+                    await q.put(msg)
+
+            c.client.add_dispatcher(d)
+            addr = tuple(c.mon.osdmap.osds[primary].addr)
+            meta, segs = pack_mutations([{"op": "append", "data": b"+x"}])
+            payload = {"pgid": pgid, "oid": "dup-obj", "ops": meta,
+                       "reqid": ["client.test:abc", 42]}
+            # send the SAME logical request twice (simulating a resend
+            # after a lost reply)
+            for _ in range(2):
+                await c.client.send(addr, f"osd.{primary}",
+                                    Message("osd_op", dict(payload),
+                                            segments=list(segs)))
+            r1 = await asyncio.wait_for(q.get(), 10)
+            r2 = await asyncio.wait_for(q.get(), 10)
+            c.client.dispatchers.remove(d)
+            assert {bool(r.data.get("dup"))
+                    for r in (r1, r2)} == {False, True}
+            # both replies carry the same committed version
+            assert r1.data["version"] == r2.data["version"]
+            reply = await c.osd_op("rbd", "dup-obj", [
+                {"op": "read", "off": 0, "len": None}])
+            _, data = read_result(reply)
+            assert data == b"base+x"          # applied exactly once
+        finally:
+            await c.stop()
+    run(main())
+
+
 def test_failure_detection_and_degraded_read():
     async def main():
         c = await make_cluster(

@@ -1,0 +1,209 @@
+"""PG scrubbing: cross-shard consistency checking and repair.
+
+src/osd/scrubber analog (pg_scrubber.cc / scrub_backend.cc): the
+primary collects a scrub map (per-object size + data crc + attr/omap
+digests) from every acting shard, compares them, and flags
+inconsistencies.  Replicated PGs majority-vote the authoritative copy
+and can repair divergent replicas by pushing it.  EC PGs deep-scrub by
+reconstructing the logical object from k shards, re-encoding, and
+byte-comparing every stored shard against the re-encode (the parity
+consistency check ECBackend gets from per-shard hashinfo crcs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+from ..native import crc32c
+from .backend import META_OID, ECBackend, SIZE_XATTR
+
+
+def build_scrub_map(store, coll: str, deep: bool = True) -> dict[str, dict]:
+    """Digest every object in a PG collection (replica side)."""
+    out: dict[str, dict] = {}
+    for oid in store.list_objects(coll):
+        if oid == META_OID:
+            continue
+        st = store.stat(coll, oid)
+        if st is None:
+            continue
+        entry: dict[str, Any] = {"size": st["size"]}
+        attrs = {k: v for k, v in store.getattrs(coll, oid).items()}
+        omap = store.omap_get(coll, oid)
+        entry["attrs_digest"] = hashlib.sha1(
+            json.dumps({k: v.hex() for k, v in sorted(attrs.items())})
+            .encode()).hexdigest()
+        entry["omap_digest"] = hashlib.sha1(
+            json.dumps({k: v.hex() for k, v in sorted(omap.items())})
+            .encode()).hexdigest()
+        if deep:
+            entry["data_digest"] = crc32c(
+                bytes(store.read(coll, oid, 0, None)))
+        out[oid] = entry
+    return out
+
+
+class ScrubResult:
+    def __init__(self, pgid: str) -> None:
+        self.pgid = pgid
+        self.objects_scrubbed = 0
+        self.inconsistent: dict[str, dict] = {}   # oid -> detail
+        self.repaired: list[str] = []
+
+    @property
+    def clean(self) -> bool:
+        return not self.inconsistent
+
+    def to_dict(self) -> dict:
+        return {"pgid": self.pgid,
+                "objects_scrubbed": self.objects_scrubbed,
+                "inconsistent": self.inconsistent,
+                "repaired": self.repaired,
+                "clean": self.clean}
+
+
+async def scrub_replicated(pg, repair: bool = False) -> ScrubResult:
+    """Compare scrub maps across replicas; majority is authoritative."""
+    res = ScrubResult(pg.pgid)
+    local = build_scrub_map(pg.osd.store, pg.coll)
+    maps: dict[int, dict[str, dict]] = {pg.whoami: local}
+    peers = [o for o in pg.acting_peers() if pg.osd.osd_is_up(o)]
+    replies = await pg.osd.fanout_and_wait(
+        [(o, "pg_scrub_map_req", {"pgid": pg.pgid}, []) for o in peers],
+        collect=True, timeout=15)
+    for rep in replies:
+        maps[rep.data["from_osd"]] = rep.data["map"]
+    all_oids = sorted(set().union(*[set(m) for m in maps.values()]))
+    res.objects_scrubbed = len(all_oids)
+    for oid in all_oids:
+        versions: dict[str, list[int]] = {}
+        for osd_id, m in maps.items():
+            key = json.dumps(m.get(oid), sort_keys=True)
+            versions.setdefault(key, []).append(osd_id)
+        if len(versions) <= 1:
+            continue
+        # majority vote picks the authoritative digest set
+        auth_key = max(versions, key=lambda k: len(versions[k]))
+        bad = {k: v for k, v in versions.items() if k != auth_key}
+        res.inconsistent[oid] = {
+            "auth_osds": versions[auth_key],
+            "bad": [{"osds": osds, "digests": json.loads(k)}
+                    for k, osds in bad.items()],
+        }
+        if repair:
+            await _repair_replicated(pg, oid, versions[auth_key], bad)
+            res.repaired.append(oid)
+    return res
+
+
+async def _repair_replicated(pg, oid: str, auth_osds: list[int],
+                             bad: dict) -> None:
+    """Push the authoritative copy over divergent replicas."""
+    from ..msg import Message
+    if pg.whoami in auth_osds:
+        payload = await pg.backend.read_recovery_payload(oid, 0)
+    else:
+        replies = await pg.osd.fanout_and_wait(
+            [(auth_osds[0], "pg_pull",
+              {"pgid": pg.pgid, "oid": oid, "shard": 0}, [])],
+            collect=True, timeout=10)
+        if not replies or replies[0].data.get("err"):
+            return
+        rep = replies[0]
+        payload = {"data": rep.segments[0] if rep.segments else b"",
+                   "xattrs": {k: bytes.fromhex(v) for k, v in
+                              rep.data.get("xattrs", {}).items()},
+                   "omap": {k: bytes.fromhex(v) for k, v in
+                            rep.data.get("omap", {}).items()},
+                   "absent": rep.data.get("absent", False)}
+        pg._apply_recovery_payload(oid, {
+            "absent": payload["absent"],
+            "xattrs": {k: v.hex() for k, v in payload["xattrs"].items()},
+            "omap": {k: v.hex() for k, v in payload["omap"].items()},
+        }, [payload["data"]])
+    # `bad` values are lists of osd ids keyed by digest json
+    bad_osds = [o for osds in bad.values() for o in osds]
+    for osd_id in bad_osds:
+        if osd_id == pg.whoami:
+            continue
+        await pg.osd.fanout_and_wait(
+            [(osd_id, "pg_push",
+              {"pgid": pg.pgid, "oid": oid,
+               "absent": payload.get("absent", False),
+               "xattrs": {k: v.hex()
+                          for k, v in payload["xattrs"].items()},
+               "omap": {k: v.hex()
+                        for k, v in payload["omap"].items()}},
+              [payload["data"]])], collect=True, timeout=10)
+
+
+async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
+    """Deep EC scrub: re-encode from k shards, compare all stored
+    shards byte-for-byte against the canonical encode."""
+    import numpy as np
+    res = ScrubResult(pg.pgid)
+    backend: ECBackend = pg.backend
+    oids = [o for o in pg.osd.store.list_objects(pg.coll)
+            if o != META_OID]
+    res.objects_scrubbed = len(oids)
+    for oid in oids:
+        bufs, size = await backend._gather_shards(
+            oid, need_shards=set(range(backend.k)))
+        if not bufs:
+            continue
+        logical = backend.sinfo.reconstruct_logical(backend.codec, bufs)
+        pad = backend.sinfo.logical_to_next_stripe_offset(size)
+        canonical = backend.sinfo.encode(
+            backend.codec, logical[:pad].ljust(pad, b"\0"))
+        # fetch every stored shard and compare
+        bad_shards: list[int] = []
+        for shard, osd_id in enumerate(pg.acting):
+            if osd_id < 0 or not pg.osd.osd_is_up(osd_id):
+                continue
+            if osd_id == pg.whoami:
+                try:
+                    raw = pg.osd.store.read(pg.coll, oid, 0, None)
+                except FileNotFoundError:
+                    raw = b""
+            else:
+                replies = await pg.osd.fanout_and_wait(
+                    [(osd_id, "ec_subop_read",
+                      {"pgid": pg.pgid, "oid": oid}, [])],
+                    collect=True, timeout=10)
+                if not replies:
+                    continue
+                raw = (replies[0].segments[0]
+                       if replies[0].segments else b"")
+            want = canonical[shard].tobytes()
+            if bytes(raw) != want:
+                bad_shards.append(shard)
+        if bad_shards:
+            res.inconsistent[oid] = {"bad_shards": bad_shards}
+            if repair:
+                for shard in bad_shards:
+                    osd_id = pg.acting[shard]
+                    payload = {"pgid": pg.pgid, "oid": oid,
+                               "absent": False,
+                               "xattrs": {SIZE_XATTR:
+                                          str(size).encode().hex()},
+                               "omap": {}}
+                    if osd_id == pg.whoami:
+                        pg._apply_recovery_payload(
+                            oid, payload,
+                            [canonical[shard].tobytes()])
+                    else:
+                        await pg.osd.fanout_and_wait(
+                            [(osd_id, "pg_push", payload,
+                              [canonical[shard].tobytes()])],
+                            collect=True, timeout=10)
+                res.repaired.append(oid)
+    return res
+
+
+async def scrub_pg(pg, repair: bool = False) -> ScrubResult:
+    async with pg.lock:
+        if isinstance(pg.backend, ECBackend):
+            return await scrub_ec(pg, repair=repair)
+        return await scrub_replicated(pg, repair=repair)

@@ -1,4 +1,4 @@
-"""Round benchmark: RS(k=8,m=3) erasure encode throughput on TPU.
+"""Round benchmark: RS(k=8,m=3) erasure encode+decode throughput on TPU.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
@@ -10,102 +10,212 @@ is asserted before timing -- a number without parity is meaningless.
 vs_baseline is measured against this repo's native C++ AVX2 encoder
 (native/gf8.cc, the ISA-L-technique split-nibble SIMD path, single
 thread), the same role ISA-L plays in the reference's
-ceph_erasure_code_benchmark CPU runs.
+ceph_erasure_code_benchmark CPU runs
+(src/test/erasure-code/ceph_erasure_code_benchmark.cc:155-193).
+
+Harness discipline (round-2 fixes):
+  * stripe batches are GENERATED ON DEVICE (jax.random) and stay resident
+    in HBM -- no per-iteration host->device upload; this is the deployment
+    shape where stripes stream through HBM between pipeline stages;
+  * progress lines go to stderr immediately at every phase;
+  * an internal deadline (BENCH_DEADLINE_S, default 270s) triggers batch
+    back-off instead of a silent timeout; the JSON line ALWAYS prints.
 """
 
 import json
 import os
+import signal
 import sys
 import time
 
 import numpy as np
+
+T0 = time.monotonic()
+RESULT = {
+    "metric": "ec_rs_k8m3_encode_decode_GiBps_tpu_vs_cpu_avx2",
+    "value": 0.0,
+    "unit": "GiB/s",
+    "vs_baseline": 0.0,
+}
+_EMITTED = False
+
+
+def log(msg: str) -> None:
+    print(f"[bench +{time.monotonic() - T0:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def emit() -> None:
+    global _EMITTED
+    if not _EMITTED:
+        _EMITTED = True
+        print(json.dumps(RESULT), flush=True)
+
+
+def _alarm(signum, frame):  # backstop: never die without the JSON line
+    log("ALARM: hard deadline hit, emitting current result")
+    RESULT.setdefault("error", "hard deadline")
+    emit()
+    os._exit(3)
+
+
+def _device_batch(rng, batch, k, chunk):
+    """(batch, k, chunk) random bytes, device-resident, tiny host upload.
+
+    A small host-random seed block is tiled on device: GF math is
+    data-independent so timing is unaffected, parity correctness is
+    validated separately on fully random data, and the footprint stays
+    minimal (the tunnel chip is shared -- large allocations and large
+    host->device transfers are the failure modes).
+    """
+    import jax
+    import jax.numpy as jnp
+    seed_rows = min(batch, 8)
+    seed = rng.integers(0, 256, size=(seed_rows, k, chunk), dtype=np.uint8)
+    dev = jax.device_put(seed)
+    reps = batch // seed_rows
+    out = jnp.tile(dev, (reps, 1, 1))
+    out.block_until_ready()
+    return out
+
+
+def _time_launches(fn, block, deadline, min_iters=3, max_iters=12):
+    """Median-free simple timing: async dispatch loop, block at the end."""
+    out = fn()
+    block(out)                      # warm / compile
+    t1 = time.perf_counter()
+    out = fn()
+    block(out)
+    per = time.perf_counter() - t1  # one-launch estimate
+    budget = max(0.5, min(3.0, deadline - time.monotonic() - 5.0))
+    iters = max(min_iters, min(max_iters, int(budget / max(per, 1e-4))))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    block(out)
+    return (time.perf_counter() - t0) / iters, iters, out
 
 
 def main() -> int:
     k, m = 8, 3
     stripe = 1 << 20                    # 1 MiB stripe
     chunk = stripe // k                 # 128 KiB per chunk
-    batch = int(os.environ.get("BENCH_BATCH", "1024"))
-    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    batch = max(8, (batch // 8) * 8)    # _device_batch tiles 8-stripe seeds
+    deadline = T0 + float(os.environ.get("BENCH_DEADLINE_S", "270"))
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(int(deadline - T0 + 60))
 
+    log(f"start: k={k} m={m} stripe={stripe} batch={batch}")
     from ceph_tpu.gf import gen_rs_matrix, gf_matmul
     from ceph_tpu.native import gf8_matmul
     from ceph_tpu.ec import registry
+    import jax
+    import jax.numpy as jnp
 
+    log(f"jax backend={jax.default_backend()} devices={jax.devices()}")
     gen = gen_rs_matrix(k + m, k)
-    rng = np.random.default_rng(0)
-
     codec = registry().factory("tpu", {"k": str(k), "m": str(m),
                                        "technique": "reed_sol_van"})
 
-    # -- parity gate --------------------------------------------------------
+    # -- parity gate (small sample; host oracle) ----------------------------
+    log("parity gate: 4 stripes x 4 KiB vs host GF oracle")
+    rng = np.random.default_rng(0)
     sample = rng.integers(0, 256, size=(4, k, 4096), dtype=np.uint8)
     got = np.asarray(codec.encode_batch(sample, out_np=True))
     for b in range(4):
         want = gf_matmul(gen[k:], sample[b])
         if not np.array_equal(got[b], want):
-            print(json.dumps({"metric": "ec_encode_rs_k8m3",
-                              "value": 0.0, "unit": "GiB/s",
-                              "vs_baseline": 0.0,
-                              "error": "byte parity failure"}))
+            RESULT["error"] = "byte parity failure"
+            emit()
             return 1
+    log("parity gate passed")
+
+    # -- device-resident stripe batch --------------------------------------
+    # the tunnel chip is shared: transient RESOURCE_EXHAUSTED from
+    # co-tenants is expected -- retry with escalating delay, shrink batch
+    fails = 0
+    while True:
+        try:
+            log(f"staging {batch * k * chunk / 2**30:.2f} GiB on device "
+                f"(batch={batch})")
+            data = _device_batch(rng, batch, k, chunk)
+            break
+        except Exception as e:  # OOM etc: retry, then back off
+            fails += 1
+            log(f"staging failed ({type(e).__name__}: {str(e)[:80]}); "
+                f"retry {fails}")
+            if time.monotonic() > deadline - 90 or fails % 2 == 0:
+                batch = max(8, (batch // 2 // 8) * 8)
+            time.sleep(min(20, 3 * fails))
+            if batch < 8 or time.monotonic() > deadline - 45:
+                RESULT["error"] = f"device alloc failed: {e}"
+                emit()
+                return 1
 
     # -- TPU encode ---------------------------------------------------------
-    data = rng.integers(0, 256, size=(batch, k, chunk), dtype=np.uint8)
-    out = codec.encode_batch(data)          # device-resident result
-    out.block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = codec.encode_batch(data)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
-    gibps = batch * k * chunk / dt / 2**30
+    log("encode: compile + timing")
+    enc_dt, enc_iters, parity = _time_launches(
+        lambda: codec.encode_batch(data),
+        lambda o: o.block_until_ready(), deadline)
+    gibps = batch * k * chunk / enc_dt / 2**30
+    log(f"encode: {gibps:.1f} GiB/s ({enc_iters} iters, {enc_dt*1e3:.2f} ms/launch)")
 
-    # -- decode (2 erasures) -------------------------------------------------
+    # -- decode (2 erasures: one data chunk, one parity chunk) --------------
     erasures = [1, 9]
     decode_index = [i for i in range(k + m) if i not in erasures][:k]
-    full = np.concatenate([data, np.zeros((batch, m, chunk), np.uint8)],
-                          axis=1)
-    full[:, k:] = np.asarray(out)
-    survivors = np.ascontiguousarray(full[:, decode_index])
-    rec = codec.decode_batch(erasures, survivors)
-    rec.block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        rec = codec.decode_batch(erasures, survivors)
-    rec.block_until_ready()
-    dt_dec = (time.perf_counter() - t0) / iters
-    dec_gibps = batch * k * chunk / dt_dec / 2**30
-    if not np.array_equal(np.asarray(rec)[:, 0], full[:, erasures[0]]):
-        print(json.dumps({"metric": "ec_encode_rs_k8m3", "value": 0.0,
-                          "unit": "GiB/s", "vs_baseline": 0.0,
-                          "error": "decode parity failure"}))
+    full = jnp.concatenate([data, parity], axis=1)
+    full.block_until_ready()
+    lost = full[:, jnp.asarray(erasures)]       # keep for the byte check
+    survivors = full[:, jnp.asarray(decode_index)]
+    survivors.block_until_ready()
+    del data, parity, full                      # bound the HBM footprint
+    log("decode: compile + timing")
+    dec_dt, dec_iters, rec = _time_launches(
+        lambda: codec.decode_batch(erasures, survivors),
+        lambda o: o.block_until_ready(), deadline)
+    dec_gibps = batch * k * chunk / dec_dt / 2**30
+    log(f"decode: {dec_gibps:.1f} GiB/s ({dec_iters} iters)")
+
+    ok = bool(jnp.array_equal(rec, lost))
+    if not ok:
+        RESULT["error"] = "decode parity failure"
+        emit()
         return 1
+    log("decode recovered chunks byte-exact")
 
     # -- CPU baseline (native AVX2, single thread) ---------------------------
+    log("cpu baseline: native gf8.cc AVX2 single thread")
     base_n = 1 << 22
     base_data = rng.integers(0, 256, size=(k, base_n), dtype=np.uint8)
     gf8_matmul(gen[k:], base_data)  # warm tables
     t0 = time.perf_counter()
-    base_iters = 8
+    base_iters = 6
     for _ in range(base_iters):
         gf8_matmul(gen[k:], base_data)
     base_dt = (time.perf_counter() - t0) / base_iters
     base_gibps = k * base_n / base_dt / 2**30
+    log(f"cpu baseline: {base_gibps:.2f} GiB/s")
 
     combined = 2 / (1 / gibps + 1 / dec_gibps)  # harmonic: encode+decode
-    print(json.dumps({
-        "metric": "ec_rs_k8m3_encode_decode_GiBps_tpu_vs_cpu_avx2",
+    RESULT.update({
         "value": round(combined, 2),
-        "unit": "GiB/s",
         "vs_baseline": round(combined / base_gibps, 2),
         "encode_GiBps": round(gibps, 2),
         "decode_GiBps": round(dec_gibps, 2),
         "cpu_baseline_GiBps": round(base_gibps, 2),
         "batch": batch, "stripe_bytes": stripe,
-    }))
+    })
+    emit()
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        rc = main()
+    except Exception as e:  # always print the JSON line
+        log(f"FATAL: {type(e).__name__}: {e}")
+        RESULT["error"] = f"{type(e).__name__}: {e}"
+        emit()
+        rc = 1
+    sys.exit(rc)

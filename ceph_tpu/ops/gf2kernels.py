@@ -48,6 +48,19 @@ def bitmatrix_i8(matrix: np.ndarray) -> np.ndarray:
     return _bitmatrix_cached(matrix.tobytes(), *matrix.shape)
 
 
+@functools.lru_cache(maxsize=256)
+def _bitmatrix_device(mat_bytes: bytes, r: int, k: int):
+    """Device-resident W: one upload per coefficient matrix, ever (the
+    per-call jnp.asarray upload is a tunnel round trip otherwise)."""
+    import jax
+    return jax.device_put(_bitmatrix_cached(mat_bytes, r, k))
+
+
+def bitmatrix_device(matrix: np.ndarray):
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    return _bitmatrix_device(matrix.tobytes(), *matrix.shape)
+
+
 def _unpack_bits(data: jnp.ndarray) -> jnp.ndarray:
     """(k, N) uint8 -> (8k, N) int8 bit planes.
 
@@ -90,14 +103,10 @@ def _gf_matmul_xla(w: jnp.ndarray, data_u8: jnp.ndarray) -> jnp.ndarray:
 # Pallas fused kernel
 # ---------------------------------------------------------------------------
 
-def _make_pallas_fn(r8: int, k: int, n: int, tile: int,
-                    interpret: bool = False):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
+def _pallas_kernel_body(r8: int, k: int, tile: int):
     def kernel(w_ref, data_ref, out_ref):
         # Mosaic has no i8 shrui; widen to i32 for the bit extraction
-        data = data_ref[:].astype(jnp.int32)  # (k, tile)
+        data = data_ref[...].reshape(k, tile).astype(jnp.int32)
         planes = [((data >> s) & 1) for s in range(8)]
         stacked = jnp.stack(planes, axis=1).reshape(8 * k, tile).astype(jnp.int8)
         acc = jax.lax.dot_general(
@@ -108,11 +117,19 @@ def _make_pallas_fn(r8: int, k: int, n: int, tile: int,
         r = r8 // 8
         b = acc.reshape(r, 8, tile)
         shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
-        out_ref[:] = (b << shifts).sum(axis=1).astype(jnp.uint8)
+        out_ref[...] = ((b << shifts).sum(axis=1).astype(jnp.uint8)
+                        .reshape(out_ref.shape))
+    return kernel
+
+
+def _make_pallas_fn(r8: int, k: int, n: int, tile: int,
+                    interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     grid = (n // tile,)
     fn = pl.pallas_call(
-        kernel,
+        _pallas_kernel_body(r8, k, tile),
         out_shape=jax.ShapeDtypeStruct((r8 // 8, n), jnp.uint8),
         grid=grid,
         in_specs=[
@@ -122,6 +139,32 @@ def _make_pallas_fn(r8: int, k: int, n: int, tile: int,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((r8 // 8, tile), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def _make_pallas_batch_fn(r8: int, k: int, b: int, l: int, tile: int,
+                          interpret: bool = False):
+    """Batched stripes without the (B,k,L)->(k,B*L) transpose copy: the
+    grid walks (stripe, tile) and each step reads a (1,k,tile) block.
+    One dispatch, HBM traffic = bytes in + parity out."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (b, l // tile)
+    fn = pl.pallas_call(
+        _pallas_kernel_body(r8, k, tile),
+        out_shape=jax.ShapeDtypeStruct((b, r8 // 8, l), jnp.uint8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((r8, 8 * k), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k, tile), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, r8 // 8, tile), lambda i, j: (i, 0, j),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )
@@ -139,7 +182,9 @@ def _compiled(r8: int, k: int, n_padded: int, use_pallas: bool):
 
 def clear_kernel_cache() -> None:
     _compiled.cache_clear()
+    _compiled_batch.cache_clear()
     _bitmatrix_cached.cache_clear()
+    _bitmatrix_device.cache_clear()
 
 
 def _want_pallas() -> bool:
@@ -168,7 +213,7 @@ def gf_matmul_device(matrix: np.ndarray, data, *, out_np: bool = True):
     ``data`` may be a numpy array or a device array; the result is returned
     as numpy when out_np (plugin path) or left on device (bench path).
     """
-    w = bitmatrix_i8(matrix)
+    w = bitmatrix_device(matrix)
     r8, k8 = w.shape
     k = k8 // 8
     n = data.shape[1]
@@ -178,22 +223,42 @@ def gf_matmul_device(matrix: np.ndarray, data, *, out_np: bool = True):
     xd = jnp.asarray(data, dtype=jnp.uint8)
     if n_pad != n:
         xd = jnp.pad(xd, ((0, 0), (0, n_pad - n)))
-    out = fn(jnp.asarray(w), xd)
+    out = fn(w, xd)
     if n_pad != n:
         out = out[:, :n]
     return np.asarray(out) if out_np else out
 
 
-def gf_matmul_batch_device(matrix: np.ndarray, data, *, out_np: bool = False):
-    """Batched stripes: (B, k, L) -> (B, r, L).
+@functools.lru_cache(maxsize=512)
+def _compiled_batch(r8: int, k: int, b: int, l: int, use_pallas: bool):
+    interpret = bool(os.environ.get("CEPH_TPU_PALLAS_INTERPRET"))
+    if use_pallas:
+        if l % LANE_TILE == 0:
+            tile = LANE_TILE
+        elif l <= LANE_TILE and l % 128 == 0:
+            tile = l
+        else:
+            tile = 0
+        if tile:
+            return _make_pallas_batch_fn(r8, k, b, l, tile,
+                                         interpret=interpret)
 
-    Columns are independent, so the batch folds into the lane dimension:
-    (B,k,L) -> transpose (k,B,L) -> (k, B*L) -> matmul -> unfold.
+    def fn(w, xd):  # whole path under one jit: one dispatch per call
+        flat = xd.transpose(1, 0, 2).reshape(k, b * l)
+        out = _gf_matmul_math(w, flat)
+        return out.reshape(r8 // 8, b, l).transpose(1, 0, 2)
+    return jax.jit(fn)
+
+
+def gf_matmul_batch_device(matrix: np.ndarray, data, *, out_np: bool = False):
+    """Batched stripes: (B, k, L) -> (B, r, L), ONE device dispatch.
+
+    Eager op-by-op dispatch is a tunnel round trip per op when the chip
+    is remote; everything (including layout changes) lives under one jit.
     """
     b, k, l = data.shape
+    w = bitmatrix_device(matrix)
     xd = jnp.asarray(data, dtype=jnp.uint8)
-    flat = xd.transpose(1, 0, 2).reshape(k, b * l)
-    out = gf_matmul_device(matrix, flat, out_np=False)
-    r = out.shape[0]
-    out = out.reshape(r, b, l).transpose(1, 0, 2)
+    fn = _compiled_batch(w.shape[0], k, b, l, _want_pallas())
+    out = fn(w, xd)
     return np.asarray(out) if out_np else out

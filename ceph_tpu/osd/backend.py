@@ -21,6 +21,21 @@ from .types import LogEntry
 
 META_OID = "_pgmeta_"
 SIZE_XATTR = "_size"
+VER_XATTR = "_ver"     # per-object version stamp, "epoch,v" (object_info_t
+                       # analog: lets readers reject stale shards and lets
+                       # backfill diff object versions without log overlap)
+HIDDEN_XATTRS = frozenset({SIZE_XATTR, VER_XATTR})   # never client-visible
+
+
+def ver_encode(version) -> bytes:
+    return f"{version.epoch},{version.version}".encode()
+
+
+def ver_decode(raw: bytes | None) -> tuple[int, int]:
+    if not raw:
+        return (0, 0)
+    a, b = raw.decode().split(",")
+    return (int(a), int(b))
 
 
 # -- wire packing: JSON meta + binary segments ------------------------------
@@ -141,6 +156,9 @@ class ReplicatedBackend(PGBackend):
     async def submit_transaction(self, entry, muts) -> None:
         txn = Transaction()
         apply_mutations(txn, self.coll, entry.oid, muts)
+        if not entry.is_delete():
+            txn.setattr(self.coll, entry.oid, VER_XATTR,
+                        ver_encode(entry.version))
         self.pg.append_log_and_meta(txn, entry)
         self.store.queue_transaction(txn)
         # fan out to every other acting replica and wait for all commits
@@ -156,6 +174,9 @@ class ReplicatedBackend(PGBackend):
         """Replica side: apply the primary's resolved mutations."""
         txn = Transaction()
         apply_mutations(txn, self.coll, entry.oid, muts)
+        if not entry.is_delete():
+            txn.setattr(self.coll, entry.oid, VER_XATTR,
+                        ver_encode(entry.version))
         self.pg.append_log_and_meta(txn, entry)
         self.store.queue_transaction(txn)
 
@@ -206,54 +227,92 @@ class ECBackend(PGBackend):
         return self.pg.acting.index(self.osd.whoami)
 
     # -- logical object reconstruction --------------------------------------
+    def _local_shard(self, oid: str):
+        """(buf, size, version) for my shard; absent -> (b'', 0, (0,0))."""
+        try:
+            raw = self.store.read(self.coll, oid, 0, None)
+        except FileNotFoundError:
+            raw = b""
+        sx = self.store.getattr(self.coll, oid, SIZE_XATTR)
+        ver = ver_decode(self.store.getattr(self.coll, oid, VER_XATTR))
+        return np.frombuffer(raw, np.uint8), int(sx) if sx else 0, ver
+
+    async def _fetch_shards(self, oid: str, shards: list[int],
+                            avail: dict[int, int]) -> dict:
+        """Fetch several shards' (buf, size, ver) with ONE parallel
+        fanout (the hot read path: serial round trips would multiply
+        latency by k)."""
+        out = {}
+        remote = []
+        for s in shards:
+            if avail[s] == self.osd.whoami:
+                out[s] = self._local_shard(oid)
+            else:
+                remote.append(s)
+        if remote:
+            replies = await self.osd.fanout_and_wait(
+                [(avail[s], "ec_subop_read",
+                  {"pgid": self.pg.pgid, "oid": oid}, []) for s in remote],
+                collect=True)
+            for rep in replies:
+                s = rep.data.get("shard")
+                if s is None:
+                    continue
+                buf = np.frombuffer(
+                    rep.segments[0] if rep.segments else b"", np.uint8)
+                out[s] = (buf, rep.data.get("size", 0),
+                          tuple(rep.data.get("ver", (0, 0))))
+            missing = [s for s in remote if s not in out]
+            if missing:
+                raise TimeoutError(
+                    f"ec_subop_read: no reply for shards {missing}")
+        return out
+
     async def _gather_shards(self, oid: str,
                              need_shards: set[int] | None = None
                              ) -> tuple[dict[int, np.ndarray], int]:
-        """Read enough shard buffers to decode; returns (bufs, size)."""
+        """Read enough CONSISTENT shard buffers to decode.
+
+        A shard OSD that missed the object (recovering peer, stale
+        incarnation) must not contribute zero-fill as if it were data --
+        decoding from it silently corrupts the reconstruction (the
+        reference gates shard reads on peer_missing / object versions).
+        Every shard write stamps VER_XATTR; here only shards carrying the
+        newest version seen participate, and minimum_to_decode is re-run
+        over the survivors when a shard is rejected.
+        """
         acting = self.pg.acting
         avail: dict[int, int] = {}           # shard -> osd
         for shard, osd in enumerate(acting):
             if osd >= 0 and self.osd.osd_is_up(osd):
                 avail[shard] = osd
-        plan = self.codec.minimum_to_decode(
-            need_shards or set(range(self.k)), set(avail))
-        bufs: dict[int, np.ndarray] = {}
-        size = 0
-        local = self.my_shard() if self.osd.whoami in acting else None
-        remote = []
-        for shard in plan:
-            if shard == local:
-                try:
-                    raw = self.store.read(self.coll, oid, 0, None)
-                except FileNotFoundError:
-                    raw = b""
-                bufs[shard] = np.frombuffer(raw, np.uint8)
-                sx = self.store.getattr(self.coll, oid, SIZE_XATTR)
-                size = int(sx) if sx else 0
-            else:
-                remote.append((avail[shard], shard))
-        if remote:
-            replies = await self.osd.fanout_and_wait(
-                [(osd, "ec_subop_read",
-                  {"pgid": self.pg.pgid, "oid": oid}, [])
-                 for osd, _ in remote], collect=True)
-            for rep in replies:
-                shard = rep.data["shard"]
-                bufs[shard] = np.frombuffer(
-                    rep.segments[0] if rep.segments else b"", np.uint8)
-                size = max(size, rep.data.get("size", 0))
-        # normalize buffer lengths (a shard that never saw the object
-        # returns empty: zero-fill to the common shard length)
-        shard_len = max((len(b) for b in bufs.values()), default=0)
-        for s, b in list(bufs.items()):
-            if len(b) < shard_len:
-                nb = np.zeros(shard_len, np.uint8)
-                nb[:len(b)] = b
-                bufs[s] = nb
-        return bufs, size
+        want = need_shards or set(range(self.k))
+        fetched: dict[int, tuple[np.ndarray, int, tuple]] = {}
+        rejected: set[int] = set()
+        for _ in range(len(acting) + 1):
+            usable = set(avail) - rejected
+            plan = set(self.codec.minimum_to_decode(want, usable))
+            fetched.update(await self._fetch_shards(
+                oid, sorted(plan - set(fetched)), avail))
+            vers = {s: fetched[s][2] for s in plan}
+            newest = max(vers.values())
+            stale = {s for s, v in vers.items() if v < newest}
+            if not stale:
+                bufs = {s: fetched[s][0] for s in plan}
+                size = max((fetched[s][1] for s in plan), default=0)
+                shard_len = max((len(b) for b in bufs.values()), default=0)
+                for s, b in list(bufs.items()):
+                    if len(b) < shard_len:
+                        nb = np.zeros(shard_len, np.uint8)
+                        nb[:len(b)] = b
+                        bufs[s] = nb
+                return bufs, size, newest
+            rejected |= stale
+        raise RuntimeError(
+            f"no consistent shard set for {oid}: rejected {sorted(rejected)}")
 
     async def _read_logical(self, oid: str) -> bytes:
-        bufs, size = await self._gather_shards(oid)
+        bufs, size, _ = await self._gather_shards(oid)
         if not bufs or not any(len(b) for b in bufs.values()):
             return b""
         data = self.sinfo.reconstruct_logical(self.codec, bufs)
@@ -265,25 +324,52 @@ class ECBackend(PGBackend):
         data_muts = [m for m in muts if m["op"] in
                      ("create", "write", "truncate", "zero", "remove")]
         attr_muts = [m for m in muts if m not in data_muts]
-        if any(m["op"] != "create" for m in data_muts):
-            logical = bytearray(await self._read_logical(entry.oid))
-            for m in data_muts:
-                if m["op"] == "write":
-                    end = m["off"] + len(m["data"])
-                    if len(logical) < end:
-                        logical.extend(b"\0" * (end - len(logical)))
-                    logical[m["off"]:end] = m["data"]
-                elif m["op"] == "truncate":
-                    if len(logical) < m["size"]:
-                        logical.extend(b"\0" * (m["size"] - len(logical)))
-                    else:
-                        del logical[m["size"]:]
-                elif m["op"] == "zero":
-                    end = min(m["off"] + m["len"], len(logical))
-                    logical[m["off"]:end] = b"\0" * max(0, end - m["off"])
-            remove = any(m["op"] == "remove" for m in data_muts)
-        else:
-            logical, remove = bytearray(), False
+        content_muts = [m for m in data_muts if m["op"] != "create"]
+        if not content_muts:
+            # create-only (touch) or attr-only: existing shard content is
+            # preserved -- re-encoding "empty" here would truncate a live
+            # object to zero (the replicated path uses touch for the same
+            # reason)
+            attr_meta, attr_segs = pack_mutations(attr_muts)
+            acting = self.pg.acting
+            awaiting = []
+            for shard, osd in enumerate(acting):
+                if osd < 0:
+                    continue
+                if osd == self.osd.whoami:
+                    self.apply_sub_write(entry, {"touch": True}, [],
+                                         attr_muts)
+                else:
+                    payload = {"pgid": self.pg.pgid, "oid": entry.oid,
+                               "shard": shard, "entry": entry.to_dict(),
+                               "w": {"touch": True},
+                               "attr_muts": attr_meta}
+                    awaiting.append((osd, "ec_subop_write", payload,
+                                     attr_segs))
+            if awaiting:
+                await self.osd.fanout_and_wait(awaiting)
+            return
+        logical = bytearray(await self._read_logical(entry.oid))
+        remove = False          # tracks the FINAL state: a remove followed
+        for m in content_muts:  # by a write recreates the object in-order
+            if m["op"] == "write":
+                end = m["off"] + len(m["data"])
+                if len(logical) < end:
+                    logical.extend(b"\0" * (end - len(logical)))
+                logical[m["off"]:end] = m["data"]
+                remove = False
+            elif m["op"] == "truncate":
+                if len(logical) < m["size"]:
+                    logical.extend(b"\0" * (m["size"] - len(logical)))
+                else:
+                    del logical[m["size"]:]
+                remove = False
+            elif m["op"] == "zero":
+                end = min(m["off"] + m["len"], len(logical))
+                logical[m["off"]:end] = b"\0" * max(0, end - m["off"])
+            elif m["op"] == "remove":
+                logical = bytearray()
+                remove = True
 
         acting = self.pg.acting
         if remove:
@@ -328,6 +414,13 @@ class ECBackend(PGBackend):
         oid = entry.oid
         if w.get("remove"):
             txn.remove(self.coll, oid)
+        elif w.get("touch"):
+            # create-only / attr-only: never rewrite shard content
+            txn.touch(self.coll, oid)
+            if self.store.getattr(self.coll, oid, SIZE_XATTR) is None:
+                txn.setattr(self.coll, oid, SIZE_XATTR, b"0")
+            txn.setattr(self.coll, oid, VER_XATTR,
+                        ver_encode(entry.version))
         else:
             buf = segs[0] if segs else b""
             txn.truncate(self.coll, oid, 0)
@@ -335,6 +428,8 @@ class ECBackend(PGBackend):
             txn.truncate(self.coll, oid, w["shard_len"])
             txn.setattr(self.coll, oid, SIZE_XATTR,
                         str(w["size"]).encode())
+            txn.setattr(self.coll, oid, VER_XATTR,
+                        ver_encode(entry.version))
         apply_mutations(txn, self.coll, oid, attr_muts)
         self.pg.append_log_and_meta(txn, entry)
         self.store.queue_transaction(txn)
@@ -350,16 +445,21 @@ class ECBackend(PGBackend):
         sx = self.store.getattr(self.coll, oid, SIZE_XATTR)
         if sx is not None:
             return int(sx)
-        _, size = await self._gather_shards(oid)
+        _, size, _ = await self._gather_shards(oid)
         return size
 
     async def read_recovery_payload(self, oid, shard) -> dict:
         """Reconstruct the target shard's buffer for a recovering peer."""
-        bufs, size = await self._gather_shards(oid, need_shards={shard})
+        bufs, size, ver = await self._gather_shards(oid, need_shards={shard})
         if shard in bufs:
             buf = bufs[shard]
         else:
             buf = self.sinfo.decode(self.codec, bufs, want={shard})[shard]
+        # the pushed shard must carry the version stamp: an unstamped
+        # recovered shard would read as (0,0) and be rejected as stale
+        # by _gather_shards forever after
+        ver_raw = f"{ver[0]},{ver[1]}".encode()
         return {"data": buf.tobytes(),
-                "xattrs": {SIZE_XATTR: str(size).encode()},
+                "xattrs": {SIZE_XATTR: str(size).encode(),
+                           VER_XATTR: ver_raw},
                 "omap": {}}

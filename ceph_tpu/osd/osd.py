@@ -501,7 +501,7 @@ class OSD:
         if pg is not None:
             entry = LogEntry.from_dict(msg.data["entry"])
             w = msg.data["w"]
-            n_data_segs = 0 if w.get("remove") else 1
+            n_data_segs = 0 if (w.get("remove") or w.get("touch")) else 1
             attr_muts = unpack_mutations(msg.data.get("attr_muts", []),
                                          msg.segments[n_data_segs:])
             pg.backend.apply_sub_write(
@@ -523,11 +523,13 @@ class OSD:
                 buf = self.store.read(pg.coll, oid, 0, None)
             except FileNotFoundError:
                 buf = b""
-            from .backend import SIZE_XATTR
+            from .backend import SIZE_XATTR, VER_XATTR, ver_decode
             sx = self.store.getattr(pg.coll, oid, SIZE_XATTR)
             size = int(sx) if sx else 0
             data["shard"] = pg._shard_of(self.whoami)
             data["size"] = size
+            data["ver"] = list(ver_decode(
+                self.store.getattr(pg.coll, oid, VER_XATTR)))
         await conn.send(Message("ec_subop_read_reply", data,
                                 segments=[buf]))
 

@@ -15,8 +15,8 @@ import json
 
 from ..os.transaction import Transaction
 from .backend import (
-    META_OID, ReplicatedBackend, apply_mutations, build_pg_backend,
-    pack_mutations, unpack_mutations,
+    HIDDEN_XATTRS, META_OID, ReplicatedBackend, apply_mutations,
+    build_pg_backend, pack_mutations, unpack_mutations,
 )
 from .pg_log import PGLog
 from .scheduler import OpClass
@@ -160,10 +160,16 @@ class PG:
             self._peering_task = asyncio.ensure_future(self.peer())
 
     async def peer(self) -> None:
-        """Run peering to completion; transient failures retry rather
-        than stranding the PG in 'peering' forever."""
+        """Run peering to completion.
+
+        Retries for as long as this interval lasts: choosing an auth log
+        from a PARTIAL set of replies would let a stale primary rewind a
+        late peer's newer client-acked writes (the reference blocks
+        peering on every unqueried up peer; an unreachable-but-up peer
+        stalls peering until the mons mark it down, which starts a new
+        interval and a fresh peering attempt)."""
         epoch = self.osd.osdmap.epoch
-        for _ in range(5):
+        while True:
             if (not self.is_primary()
                     or self.osd.osdmap.epoch != epoch):
                 return       # a newer interval owns peering now
@@ -194,6 +200,14 @@ class PG:
             self.peer_info[osd_id] = PGInfo.from_dict(rep.data["info"])
             self.peer_log_entries[osd_id] = [
                 LogEntry.from_dict(e) for e in rep.data["entries"]]
+        # every probe target that is still up MUST have answered before
+        # an auth log is chosen -- a missing reply may hide the most
+        # advanced history (PeeringState blocks on unqueried peers)
+        unheard = [o for o in targets
+                   if o not in self.peer_info and self.osd.osd_is_up(o)]
+        if unheard:
+            raise asyncio.TimeoutError(
+                f"pg {self.pgid}: no GetInfo reply from up peers {unheard}")
         # GetLog: adopt the most advanced history as authoritative
         best_osd, best_info = self.whoami, self.info
         for osd_id, pinfo in self.peer_info.items():
@@ -294,13 +308,27 @@ class PG:
             for peer, ms in self.peer_missing.items():
                 if ms.is_missing(oid) and self.osd.osd_is_up(peer):
                     await self._push_object(peer, oid)
+            # ops execute strictly in vector order (the reference runs
+            # the vector through one ObjectContext): reads that follow
+            # writes observe the accumulated pending state via an
+            # overlay snapshot; all writes commit atomically at the end
             results: list[dict] = []
             segments: list[bytes] = []
             writes: list[dict] = []
+            overlay: dict | None = None
+            applied = 0
             for op in ops:
                 name = op["op"]
                 if name in READ_OPS:
-                    r, seg = await self._do_read_op(oid, op)
+                    if writes:
+                        if overlay is None:
+                            overlay = await self._make_overlay(oid)
+                        if applied < len(writes):
+                            self._apply_overlay(overlay, writes[applied:])
+                            applied = len(writes)
+                        r, seg = self._read_overlay_op(overlay, oid, op)
+                    else:
+                        r, seg = await self._do_read_op(oid, op)
                     if seg is not None:
                         r["seg"] = len(segments)
                         segments.append(seg)
@@ -316,6 +344,106 @@ class PG:
                     return ({"err": err}, [])
             return ({"results": results,
                      "version": self.info.last_update.to_list()}, segments)
+
+    # -- pending-write overlay (in-order read-after-write) -------------------
+    async def _make_overlay(self, oid: str) -> dict:
+        exists = self.osd.store.exists(self.coll, oid) or \
+            (not isinstance(self.backend, ReplicatedBackend)
+             and await self.backend.object_size(oid) > 0)
+        if not exists:
+            return {"exists": False, "data": bytearray(),
+                    "xattrs": {}, "omap": {}}
+        data = bytearray(await self.backend.object_read(oid, 0, None))
+        try:
+            xattrs = dict(self.osd.store.getattrs(self.coll, oid))
+        except FileNotFoundError:
+            xattrs = {}
+        return {"exists": True, "data": data, "xattrs": xattrs,
+                "omap": dict(self.osd.store.omap_get(self.coll, oid))}
+
+    def _apply_overlay(self, ov: dict, ops: list[dict]) -> None:
+        for op in ops:
+            name = op["op"]
+            if name == "create":
+                ov["exists"] = True
+            elif name == "write":
+                off, data = op.get("off", 0), op["data"]
+                end = off + len(data)
+                if len(ov["data"]) < end:
+                    ov["data"].extend(b"\0" * (end - len(ov["data"])))
+                ov["data"][off:end] = data
+                ov["exists"] = True
+            elif name == "writefull":
+                ov["data"] = bytearray(op["data"])
+                ov["exists"] = True
+            elif name == "append":
+                ov["data"].extend(op["data"])
+                ov["exists"] = True
+            elif name == "truncate":
+                size = op["size"]
+                if len(ov["data"]) < size:
+                    ov["data"].extend(b"\0" * (size - len(ov["data"])))
+                else:
+                    del ov["data"][size:]
+                ov["exists"] = True
+            elif name == "zero":
+                end = min(op["off"] + op["len"], len(ov["data"]))
+                if end > op["off"]:
+                    ov["data"][op["off"]:end] = b"\0" * (end - op["off"])
+            elif name == "remove":
+                ov.update(exists=False, data=bytearray(),
+                          xattrs={}, omap={})
+            elif name == "setxattr":
+                ov["xattrs"][op["name"]] = bytes(op["value"])
+                ov["exists"] = True
+            elif name == "rmxattr":
+                ov["xattrs"].pop(op["name"], None)
+            elif name == "omap_set":
+                ov["omap"].update({k: bytes(v)
+                                   for k, v in op["kv"].items()})
+                ov["exists"] = True
+            elif name == "omap_rm":
+                for k in op["keys"]:
+                    ov["omap"].pop(k, None)
+            elif name == "omap_clear":
+                ov["omap"].clear()
+
+    def _read_overlay_op(self, ov: dict, oid: str,
+                         op: dict) -> tuple[dict, bytes | None]:
+        name = op["op"]
+        if name == "list":
+            oids = {o for o in self.osd.store.list_objects(self.coll)
+                    if o != META_OID}
+            (oids.add if ov["exists"] else oids.discard)(oid)
+            return {"ok": True, "oids": sorted(oids)}, None
+        if name == "stat":
+            if not ov["exists"]:
+                return {"err": "ENOENT"}, None
+            return {"ok": True, "size": len(ov["data"])}, None
+        if not ov["exists"]:
+            return {"err": "ENOENT"}, None
+        if name == "read":
+            off = op.get("off", 0)
+            ln = op.get("len")
+            seg = bytes(ov["data"][off:] if ln is None
+                        else ov["data"][off:off + ln])
+            return {"ok": True, "len": len(seg)}, seg
+        if name == "getxattr":
+            v = (None if op["name"] in HIDDEN_XATTRS
+                 else ov["xattrs"].get(op["name"]))
+            if v is None:
+                return {"err": "ENODATA"}, None
+            return {"ok": True}, v
+        if name == "getxattrs":
+            return {"ok": True,
+                    "attrs": {k: v.hex()
+                              for k, v in ov["xattrs"].items()
+                              if k not in HIDDEN_XATTRS}}, None
+        if name == "omap_get":
+            return {"ok": True,
+                    "omap": {k: v.hex()
+                             for k, v in ov["omap"].items()}}, None
+        return {"err": f"EOPNOTSUPP {name}"}, None
 
     async def _do_read_op(self, oid: str,
                           op: dict) -> tuple[dict, bytes | None]:
@@ -339,14 +467,16 @@ class PG:
             size = await self.backend.object_size(oid)
             return {"ok": True, "size": size}, None
         if name == "getxattr":
-            v = self.osd.store.getattr(self.coll, oid, op["name"])
+            v = (None if op["name"] in HIDDEN_XATTRS
+                 else self.osd.store.getattr(self.coll, oid, op["name"]))
             if v is None:
                 return {"err": "ENODATA"}, None
             return {"ok": True}, v
         if name == "getxattrs":
             attrs = self.osd.store.getattrs(self.coll, oid)
             return {"ok": True,
-                    "attrs": {k: v.hex() for k, v in attrs.items()}}, None
+                    "attrs": {k: v.hex() for k, v in attrs.items()
+                              if k not in HIDDEN_XATTRS}}, None
         if name == "omap_get":
             omap = self.osd.store.omap_get(self.coll, oid)
             return {"ok": True,
@@ -359,39 +489,53 @@ class PG:
         entry, run the backend transaction."""
         size = await self.backend.object_size(oid)
         muts: list[dict] = []
-        is_delete = False
-        for op in ops:
+        is_delete = False       # tracks the FINAL state: remove followed
+        for op in ops:          # by a recreate is a MODIFY, not a DELETE
             name = op["op"]
             if name == "create":
                 muts.append({"op": "create"})
+                is_delete = False
             elif name == "write":
                 data = op["data"]
                 muts.append({"op": "write", "off": op.get("off", 0),
                              "data": data})
                 size = max(size, op.get("off", 0) + len(data))
+                is_delete = False
             elif name == "writefull":
                 data = op["data"]
                 muts.append({"op": "truncate", "size": 0})
                 muts.append({"op": "write", "off": 0, "data": data})
                 size = len(data)
+                is_delete = False
             elif name == "append":
                 data = op["data"]
                 muts.append({"op": "write", "off": size, "data": data})
                 size += len(data)
+                is_delete = False
             elif name == "truncate":
                 muts.append({"op": "truncate", "size": op["size"]})
                 size = op["size"]
+                is_delete = False
             elif name == "zero":
-                muts.append({"op": "zero", "off": op["off"],
-                             "len": op["len"]})
+                # reference semantics: zero never extends the object
+                # (PrimaryLogPG CEPH_OSD_OP_ZERO truncates the range)
+                zlen = min(op["len"], max(0, size - op["off"]))
+                if zlen > 0:
+                    muts.append({"op": "zero", "off": op["off"],
+                                 "len": zlen})
             elif name == "remove":
                 muts.append({"op": "remove"})
                 is_delete = True
                 size = 0
             elif name == "setxattr":
+                if op["name"] in HIDDEN_XATTRS:
+                    return f"EINVAL reserved xattr {op['name']}"
                 muts.append({"op": "setxattr", "name": op["name"],
                              "value": op["value"]})
+                is_delete = False
             elif name == "rmxattr":
+                if op["name"] in HIDDEN_XATTRS:
+                    return f"EINVAL reserved xattr {op['name']}"
                 muts.append({"op": "rmxattr", "name": op["name"]})
             elif name == "omap_set":
                 muts.append({"op": "omap_set", "kv": op["kv"]})

@@ -149,7 +149,7 @@ async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
             if o != META_OID]
     res.objects_scrubbed = len(oids)
     for oid in oids:
-        bufs, size = await backend._gather_shards(
+        bufs, size, _ = await backend._gather_shards(
             oid, need_shards=set(range(backend.k)))
         if not bufs:
             continue

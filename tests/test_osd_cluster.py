@@ -357,3 +357,121 @@ def test_replicated_failover_and_recovery():
         finally:
             await c.stop()
     run(main())
+
+
+def test_op_vector_in_order_read_after_write():
+    """Reads placed after writes in one op vector observe the pending
+    write state (PrimaryLogPG runs the vector through one ObjectContext
+    in order)."""
+    async def main():
+        c = await make_cluster(3)
+        try:
+            await c.command("osd pool create",
+                            {"name": "rbd", "pg_num": 4, "size": 3,
+                             "min_size": 2})
+            await c.osd_op("rbd", "seq", [
+                {"op": "write", "off": 0, "data": b"AAAA"}])
+            # write then read in ONE vector: the read sees the write
+            reply = await c.osd_op("rbd", "seq", [
+                {"op": "write", "off": 0, "data": b"BBBB"},
+                {"op": "read", "off": 0, "len": None},
+                {"op": "append", "data": b"CC"},
+                {"op": "stat"},
+            ])
+            r1, data = read_result(reply, 1)
+            assert r1["ok"] and data == b"BBBB"
+            r3, _ = read_result(reply, 3)
+            assert r3["size"] == 6          # BBBB + CC
+            # and the commit is atomic: final state reflects both writes
+            reply = await c.osd_op("rbd", "seq", [
+                {"op": "read", "off": 0, "len": None}])
+            _, data = read_result(reply)
+            assert data == b"BBBBCC"
+            # read-after-remove in one vector -> ENOENT, then recreate
+            reply = await c.osd_op("rbd", "seq", [
+                {"op": "remove"},
+                {"op": "stat"},
+                {"op": "write", "off": 0, "data": b"new"},
+                {"op": "read", "off": 0, "len": None},
+            ])
+            r1, _ = read_result(reply, 1)
+            assert r1.get("err") == "ENOENT"
+            r3, data = read_result(reply, 3)
+            assert data == b"new"
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_ec_create_and_attr_only_preserve_data():
+    """create / attr-only op vectors on an EC pool must not re-encode
+    (and so truncate) existing object content."""
+    async def main():
+        c = await make_cluster(3)
+        try:
+            await c.command("osd erasure-code-profile set",
+                            {"name": "p21",
+                             "profile": {"plugin": "tpu", "k": "2",
+                                         "m": "1",
+                                         "technique": "reed_sol_van"}})
+            await c.command("osd pool create",
+                            {"name": "ecpool", "type": "erasure",
+                             "pg_num": 4, "erasure_code_profile": "p21"})
+            payload = bytes(range(256)) * 32
+            await c.osd_op("ecpool", "obj", [
+                {"op": "write", "off": 0, "data": payload}])
+            # create on an existing object: touch semantics, keeps bytes
+            await c.osd_op("ecpool", "obj", [{"op": "create"}])
+            reply = await c.osd_op("ecpool", "obj", [
+                {"op": "read", "off": 0, "len": None}])
+            r, data = read_result(reply)
+            assert r["ok"] and data == payload, "create destroyed EC data"
+            # attr-only vector: also preserves content
+            await c.osd_op("ecpool", "obj", [
+                {"op": "setxattr", "name": "a", "value": b"v"},
+                {"op": "omap_set", "kv": {"k": b"v"}}])
+            reply = await c.osd_op("ecpool", "obj", [
+                {"op": "read", "off": 0, "len": None},
+                {"op": "getxattr", "name": "a"}])
+            r, data = read_result(reply, 0)
+            assert data == payload, "attr-only op destroyed EC data"
+            _, xv = read_result(reply, 1)
+            assert xv == b"v"
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_ec_remove_recreate_one_vector_and_reserved_xattrs():
+    async def main():
+        c = await make_cluster(3)
+        try:
+            await c.command("osd erasure-code-profile set",
+                            {"name": "p21",
+                             "profile": {"plugin": "tpu", "k": "2",
+                                         "m": "1",
+                                         "technique": "reed_sol_van"}})
+            await c.command("osd pool create",
+                            {"name": "ecpool", "type": "erasure",
+                             "pg_num": 4, "erasure_code_profile": "p21"})
+            await c.osd_op("ecpool", "rr", [
+                {"op": "write", "off": 0, "data": b"old-content"}])
+            # remove + recreate in ONE vector: final state is the new data
+            await c.osd_op("ecpool", "rr", [
+                {"op": "remove"},
+                {"op": "write", "off": 0, "data": b"recreated"}])
+            reply = await c.osd_op("ecpool", "rr", [
+                {"op": "read", "off": 0, "len": None}])
+            r, data = read_result(reply)
+            assert r["ok"] and data == b"recreated", data
+            # clients cannot clobber reserved internal xattrs
+            reply = await c.osd_op("ecpool", "rr", [
+                {"op": "setxattr", "name": "_size", "value": b"999"}])
+            assert "EINVAL" in (reply.data.get("err") or "")
+            reply = await c.osd_op("ecpool", "rr", [
+                {"op": "read", "off": 0, "len": None}])
+            _, data = read_result(reply)
+            assert data == b"recreated"
+        finally:
+            await c.stop()
+    run(main())

@@ -1,0 +1,83 @@
+"""Scratch: multi-stripe-per-step GF kernel (deleted before commit)."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from ceph_tpu.gf import gen_rs_matrix, gf_matmul
+from ceph_tpu.ops import gf2kernels as g
+
+k, m = 8, 3
+b, l = 512, 131072
+gen = gen_rs_matrix(k + m, k)
+W = g.bitmatrix_i8(gen[k:])
+r8 = W.shape[0]
+r = r8 // 8
+W_pm = np.concatenate([W[:, s::8] for s in range(8)], axis=1)
+P = np.zeros((r, r8), np.int8)
+for i in range(r):
+    for s in range(8):
+        P[i, 8 * i + s] = -128 if s == 7 else (1 << s)
+wd, pd = jax.device_put(W_pm), jax.device_put(P)
+
+def make_ms(b_, l_, S, T):
+    def kernel(w_ref, p_ref, data_ref, out_ref):
+        for st in range(S):
+            x = data_ref[st].astype(jnp.int32)       # (k, T)
+            bits = jnp.zeros((r8, T), jnp.int32)
+            for s in range(8):
+                plane = ((x >> s) & 1).astype(jnp.int8)
+                bits ^= lax.dot_general(
+                    w_ref[:, s * k:(s + 1) * k], plane,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+            packed = lax.dot_general(p_ref[:], (bits & 1).astype(jnp.int8),
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.int32)
+            out_ref[st] = (packed & 255).astype(jnp.uint8)
+    grid = (b_ // S, l_ // T)
+    return jax.jit(pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b_, r, l_), jnp.uint8),
+        grid=grid,
+        in_specs=[pl.BlockSpec((r8, 8 * k), lambda i, j: (0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((r, r8), lambda i, j: (0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((S, k, T), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((S, r, T), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM)))
+
+rng = np.random.default_rng(0)
+small = rng.integers(0, 256, size=(4, k, 8192), dtype=np.uint8)
+fn_small = make_ms(4, 8192, 2, 8192)
+got = np.asarray(fn_small(wd, pd, jax.device_put(small)))
+ok = all(np.array_equal(got[i], gf_matmul(gen[k:], small[i]))
+         for i in range(4))
+print("parity", "ok" if ok else "MISMATCH", flush=True)
+
+gib = b * k * l / 2**30
+for S, T in ((8, 8192), (16, 8192), (32, 8192)):
+    try:
+        kern = make_ms(b, l, S, T)
+        R = 8
+        @jax.jit
+        def chained(w_, p_, salt):
+            x0 = lax.broadcasted_iota(jnp.uint8, (b, k, l), 2) + salt
+            def step(x, _):
+                pr = kern(w_, p_, x)
+                nxt = x.at[:, 0, :].set(pr[:, 0, :])
+                return nxt, jnp.sum(pr, dtype=jnp.uint32)
+            _, sums = lax.scan(step, x0, None, length=R)
+            return jnp.sum(sums)
+        float(chained(wd, pd, jnp.uint8(0)))
+        t0 = time.perf_counter(); n = 3
+        for i in range(n):
+            float(chained(wd, pd, jnp.uint8(i)))
+        dt = (time.perf_counter() - t0) / n / R
+        print(f"S={S:3d} T={T:6d}: {dt*1e3:8.2f} ms/encode "
+              f"{gib/dt:8.1f} GiB/s", flush=True)
+    except Exception as e:
+        print(f"S={S} T={T} FAIL {str(e)[:150]}", flush=True)

@@ -1,0 +1,57 @@
+"""AsyncReserver: bounded, priority-ordered reservation slots.
+
+The analog of src/common/AsyncReserver.h: recovery/backfill work must
+take a slot before moving data so a recovering cluster cannot saturate
+every OSD at once (the slot count is the `osd_max_backfills` knob).
+Local and remote reservations use the same primitive -- the remote side
+simply services requests arriving as messages.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+
+
+class AsyncReserver:
+    def __init__(self, max_allowed: int = 1) -> None:
+        self.max_allowed = max_allowed
+        self.granted: set = set()
+        self._queue: list[tuple[int, int, object, asyncio.Future]] = []
+        self._seq = 0
+
+    def _do_grants(self) -> None:
+        while self._queue and len(self.granted) < self.max_allowed:
+            _, _, item, fut = heapq.heappop(self._queue)
+            if fut.done():          # cancelled while queued
+                continue
+            self.granted.add(item)
+            fut.set_result(True)
+
+    async def request(self, item, prio: int = 0,
+                      timeout: float | None = None) -> None:
+        """Wait for a slot.  Re-requesting a granted item is a no-op."""
+        if item in self.granted:
+            return
+        fut = asyncio.get_event_loop().create_future()
+        heapq.heappush(self._queue, (-prio, self._seq, item, fut))
+        self._seq += 1
+        self._do_grants()
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self.cancel(item)
+            raise
+
+    def release(self, item) -> None:
+        self.granted.discard(item)
+        self._do_grants()
+
+    def cancel(self, item) -> None:
+        """Drop a queued (or granted) reservation."""
+        for entry in self._queue:
+            if entry[2] == item and not entry[3].done():
+                entry[3].cancel()
+        self._queue = [e for e in self._queue if not e[3].done()]
+        heapq.heapify(self._queue)
+        self.release(item)

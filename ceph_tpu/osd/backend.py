@@ -451,6 +451,10 @@ class ECBackend(PGBackend):
     async def read_recovery_payload(self, oid, shard) -> dict:
         """Reconstruct the target shard's buffer for a recovering peer."""
         bufs, size, ver = await self._gather_shards(oid, need_shards={shard})
+        if ver == (0, 0) and not any(len(b) for b in bufs.values()):
+            # object exists on no shard: tell the peer to remove its
+            # copy (backfill pushes extras as absent)
+            return {"data": b"", "xattrs": {}, "omap": {}, "absent": True}
         if shard in bufs:
             buf = bufs[shard]
         else:

@@ -35,6 +35,7 @@ class OSD:
         self.config = {
             "osd_heartbeat_interval": 0.5,
             "osd_heartbeat_grace": 3.0,
+            "osd_max_backfills": 2,
             **(config or {}),
         }
         # typed registry over the same values: admin-socket `config set`
@@ -53,6 +54,14 @@ class OSD:
         self.osdmap = OSDMap()
         self.pgs: dict[str, PG] = {}
         self.sched = MClockScheduler()
+        # backfill reservation slots (AsyncReserver.h / osd_max_backfills):
+        # local = backfills this OSD primaries, remote = backfills
+        # targeting this OSD
+        from ..common.reserver import AsyncReserver
+        self.local_reserver = AsyncReserver(
+            int(self.config["osd_max_backfills"]))
+        self.remote_reserver = AsyncReserver(
+            int(self.config["osd_max_backfills"]))
         self._sched_event = asyncio.Event()
         self._tid = itertools.count(1)
         self._waiters: dict[int, asyncio.Future] = {}
@@ -595,4 +604,56 @@ class OSD:
         await conn.send(Message("pg_push_reply", data))
 
     async def _h_pg_push_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    # backfill (scan diff + completion + reservations)
+    async def _h_pg_scan(self, conn, msg) -> None:
+        pg = self._get_pg(msg.data["pgid"])
+        data = {"tid": msg.data.get("tid"), "from_osd": self.whoami}
+        if pg is None:
+            data["err"] = "ENXIO"
+        else:
+            data["objects"] = {o: list(v)
+                               for o, v in pg.object_vers().items()}
+        await conn.send(Message("pg_scan_reply", data))
+
+    async def _h_pg_scan_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    async def _h_pg_backfill_done(self, conn, msg) -> None:
+        pg = self._get_pg(msg.data["pgid"])
+        if pg is None:
+            data = {"err": "ENXIO", "from_osd": self.whoami}
+        else:
+            data = pg.on_backfill_done()
+        data["tid"] = msg.data.get("tid")
+        await conn.send(Message("pg_backfill_done_reply", data))
+
+    async def _h_pg_backfill_done_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    async def _h_backfill_reserve(self, conn, msg) -> None:
+        """Grant-or-busy: the primary polls again next recovery round
+        rather than queueing forever on a busy target."""
+        token = msg.data["pgid"]
+        try:
+            await self.remote_reserver.request(token, timeout=5)
+            granted = True
+        except asyncio.TimeoutError:
+            granted = False
+        await conn.send(Message("backfill_reserve_reply",
+                                {"tid": msg.data.get("tid"),
+                                 "granted": granted,
+                                 "from_osd": self.whoami}))
+
+    async def _h_backfill_reserve_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    async def _h_backfill_release(self, conn, msg) -> None:
+        self.remote_reserver.release(msg.data["pgid"])
+        await conn.send(Message("backfill_release_reply",
+                                {"tid": msg.data.get("tid"),
+                                 "from_osd": self.whoami}))
+
+    async def _h_backfill_release_reply(self, conn, msg) -> None:
         self._resolve_tid(msg)

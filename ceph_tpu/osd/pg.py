@@ -47,6 +47,7 @@ class PG:
         self.peer_info: dict[int, PGInfo] = {}
         self.peer_log_entries: dict[int, list[LogEntry]] = {}
         self.peer_missing: dict[int, MissingSet] = {}
+        self.backfill_targets: set[int] = set()
         self.past_intervals = PastIntervals()
         self.up: list[int] = []
         self.acting: list[int] = []
@@ -106,9 +107,17 @@ class PG:
                 self.log.trim(self.log.entries[-LOG_CAP].version)
                 self._reindex_reqids()
             self.info.last_update = entry.version
+            self.info.log_tail = self.log.tail
             if not self.missing:
                 self.info.last_complete = entry.version
         self.persist_meta(txn)
+
+    def _sync_info_from_log(self) -> None:
+        """info mirrors the log after merges/trims -- peers decide
+        overlap-vs-backfill from the ADVERTISED tail, so a stale
+        info.log_tail would hide trim gaps."""
+        self.info.last_update = self.log.head
+        self.info.log_tail = self.log.tail
 
     def _reindex_reqids(self) -> None:
         """Rebuild the dup-detection index from the trimmed log
@@ -208,41 +217,135 @@ class PG:
         if unheard:
             raise asyncio.TimeoutError(
                 f"pg {self.pgid}: no GetInfo reply from up peers {unheard}")
-        # GetLog: adopt the most advanced history as authoritative
-        best_osd, best_info = self.whoami, self.info
-        for osd_id, pinfo in self.peer_info.items():
+        # GetLog: adopt the most advanced BACKFILL-COMPLETE history (a
+        # mid-backfill peer's log was adopted wholesale, so its
+        # last_update overstates what its data holds)
+        candidates = [(self.whoami, self.info)] \
+            if self.info.backfill_complete else []
+        candidates += [(o, pi) for o, pi in self.peer_info.items()
+                       if pi.backfill_complete]
+        if not candidates:      # nobody finished backfill: best effort
+            candidates = ([(self.whoami, self.info)]
+                          + list(self.peer_info.items()))
+        best_osd, best_info = candidates[0]
+        for osd_id, pinfo in candidates[1:]:
             if pinfo.last_update > best_info.last_update:
                 best_osd, best_info = osd_id, pinfo
         if best_osd != self.whoami:
+            primary_gap = (not self.log.overlaps(best_info)
+                           or not self.info.backfill_complete)
             auth_entries = self.peer_log_entries[best_osd]
+            if primary_gap:
+                self.info.backfill_complete = False
             divergent = self.log.merge(auth_entries, best_info, self.missing)
             self._clean_divergent(divergent)
             self._reindex_reqids()
+            self._sync_info_from_log()
+            if primary_gap:
+                # log-based recovery cannot bridge the trim gap: diff
+                # the full object set against the auth peer by version
+                await self._backfill_self(best_osd)
         # GetMissing: what does each acting peer need?
         auth_log = self.log
+        self.backfill_targets.clear()
         for osd_id in self.acting_peers():
             pinfo = self.peer_info.get(osd_id)
             if pinfo is None:
                 continue
-            self.peer_missing[osd_id] = PGLog.proc_replica_log(
-                pinfo, self.peer_log_entries.get(osd_id, []), auth_log)
+            if (pinfo.last_update < auth_log.tail
+                    or not pinfo.backfill_complete):
+                # peer's log cannot bridge: whole-PG scan diff
+                self.backfill_targets.add(osd_id)
+                self.peer_missing[osd_id] = await self._scan_diff_for_peer(
+                    osd_id)
+            else:
+                self.peer_missing[osd_id] = PGLog.proc_replica_log(
+                    pinfo, self.peer_log_entries.get(osd_id, []), auth_log)
         # Activate: ship the authoritative log to the acting set
         self.info.last_epoch_started = epoch
+        act_targets = [o for o in self.acting_peers()
+                       if self.osd.osd_is_up(o)]
         acts = [(o, "pg_activate",
                  {"pgid": self.pgid, "epoch": epoch,
                   "info": self.info.to_dict(),
                   "entries": [e.to_dict() for e in self.log.entries]}, [])
-                for o in self.acting_peers() if self.osd.osd_is_up(o)]
+                for o in act_targets]
         replies = await self.osd.fanout_and_wait(acts, collect=True,
                                                  timeout=5)
+        acked = set()
         for rep in replies:
             osd_id = rep.data["from_osd"]
-            self.peer_missing[osd_id] = MissingSet.from_dict(
-                rep.data["missing"])
+            acked.add(osd_id)
+            replica_missing = MissingSet.from_dict(rep.data["missing"])
+            if osd_id in self.backfill_targets:
+                # the scan diff is the complete picture; the replica's
+                # own view (auth-window objects only) folds into it
+                self.peer_missing[osd_id].items.update(
+                    replica_missing.items)
+            else:
+                self.peer_missing[osd_id] = replica_missing
+        unacked = [o for o in act_targets
+                   if o not in acked and self.osd.osd_is_up(o)]
+        if unacked:
+            raise asyncio.TimeoutError(
+                f"pg {self.pgid}: no activate ack from up peers {unacked}")
         self.state = "active"
         self.persist_meta()
         if self.missing or any(self.peer_missing.values()):
             self.kick_recovery()
+
+    def object_vers(self) -> dict[str, tuple[int, int]]:
+        """oid -> stored version stamp for every object in this PG."""
+        from .backend import VER_XATTR, ver_decode
+        out: dict[str, tuple[int, int]] = {}
+        for oid in self.osd.store.list_objects(self.coll):
+            if oid == META_OID:
+                continue
+            out[oid] = ver_decode(
+                self.osd.store.getattr(self.coll, oid, VER_XATTR))
+        return out
+
+    async def _fetch_scan(self, osd_id: int) -> dict[str, tuple[int, int]]:
+        replies = await self.osd.fanout_and_wait(
+            [(osd_id, "pg_scan", {"pgid": self.pgid}, [])],
+            collect=True, timeout=10)
+        if not replies or replies[0].data.get("err"):
+            raise asyncio.TimeoutError(f"pg_scan osd.{osd_id} failed")
+        return {o: tuple(v)
+                for o, v in replies[0].data["objects"].items()}
+
+    async def _scan_diff_for_peer(self, osd_id: int) -> MissingSet:
+        """Whole-PG backfill diff: every object whose stored version
+        differs from ours must be pushed; objects only the peer has are
+        pushed as absent (= removed there)."""
+        peer_objs = await self._fetch_scan(osd_id)
+        ms = MissingSet()
+        local = self.object_vers()
+        for oid, ver in local.items():
+            if peer_objs.get(oid) != ver:
+                ms.add(oid, need=EVersion(*ver), have=ZERO)
+        for oid in peer_objs:
+            if oid not in local:
+                ms.add(oid, need=self.info.last_update, have=ZERO)
+        return ms
+
+    async def _backfill_self(self, auth_osd: int) -> None:
+        """The PRIMARY's own data is gapped: pull-diff against the auth
+        peer.  Objects with differing versions go to the missing set
+        (recovered via the normal pull path); local extras are removed."""
+        auth_objs = await self._fetch_scan(auth_osd)
+        local = self.object_vers()
+        for oid, ver in auth_objs.items():
+            if local.get(oid) != ver:
+                self.missing.add(oid, need=EVersion(*ver), have=ZERO)
+        txn = Transaction()
+        extras = [oid for oid in local if oid not in auth_objs]
+        for oid in extras:
+            txn.remove(self.coll, oid)
+            self.missing.items.pop(oid, None)
+        if extras:
+            self.osd.store.queue_transaction(txn)
+        self.persist_meta()
 
     def on_query(self) -> dict:
         return {"pgid": self.pgid, "info": self.info.to_dict(),
@@ -254,10 +357,15 @@ class PG:
             auth_info = PGInfo.from_dict(msg.data["info"])
             auth_entries = [LogEntry.from_dict(e)
                             for e in msg.data["entries"]]
+            if not self.log.overlaps(auth_info):
+                # adopting the log wholesale across a trim gap: data is
+                # NOT caught up until the primary's backfill finishes
+                self.info.backfill_complete = False
             divergent = self.log.merge(auth_entries, auth_info,
                                        self.missing)
             self._clean_divergent(divergent)
             self._reindex_reqids()
+            self._sync_info_from_log()
             self.info.last_epoch_started = msg.data["epoch"]
             if not self.missing:
                 self.info.last_complete = self.info.last_update
@@ -265,6 +373,15 @@ class PG:
             self.persist_meta()
             return {"pgid": self.pgid, "missing": self.missing.to_dict(),
                     "from_osd": self.whoami}
+
+    def on_backfill_done(self) -> dict:
+        """Primary finished pushing the scan diff: our data now matches
+        our (wholesale-adopted) log."""
+        self.info.backfill_complete = True
+        if not self.missing:
+            self.info.last_complete = self.info.last_update
+        self.persist_meta()
+        return {"pgid": self.pgid, "from_osd": self.whoami}
 
     def _clean_divergent(self, divergent: list[LogEntry]) -> None:
         """Remove objects that exist locally only because of divergent
@@ -565,7 +682,12 @@ class PG:
 
     async def _recovery_loop(self) -> None:
         """Recover until clean; transient peer failures (reboots, races)
-        back off and retry rather than abandoning recovery."""
+        back off and retry rather than abandoning recovery.
+
+        Log-based pulls/pushes run directly; whole-PG backfill pushes
+        take local + remote AsyncReserver slots first so a recovering
+        cluster can't saturate every OSD at once (AsyncReserver.h,
+        osd_max_backfills)."""
         try:
             for _ in range(60):
                 if self.state != "active" or not self._recovery_pending():
@@ -575,13 +697,17 @@ class PG:
                     async with self.lock:
                         for oid in list(self.missing.items):
                             await self._recover_object(oid)
+                        if not self.missing:
+                            if not self.info.backfill_complete:
+                                self.info.backfill_complete = True
+                            self.info.last_complete = self.info.last_update
                         for peer, ms in list(self.peer_missing.items()):
-                            if not self.osd.osd_is_up(peer):
+                            if (not self.osd.osd_is_up(peer)
+                                    or peer in self.backfill_targets):
                                 continue
                             for oid in list(ms.items):
                                 await self._push_object(peer, oid)
-                        if not self.missing:
-                            self.info.last_complete = self.info.last_update
+                        await self._do_backfills()
                         self.persist_meta()
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     pass
@@ -589,6 +715,51 @@ class PG:
                     await asyncio.sleep(0.5)
         except asyncio.CancelledError:
             pass
+
+    async def _do_backfills(self) -> None:
+        """Push the scan diff to each backfill target under reservation
+        slots, then tell it backfill is complete."""
+        for peer in list(self.backfill_targets):
+            if not self.osd.osd_is_up(peer):
+                continue
+            ms = self.peer_missing.get(peer)
+            if ms is None:
+                continue
+            token = (self.pgid, peer)
+            granted_remote = False
+            try:
+                await self.osd.local_reserver.request(token, timeout=10)
+                replies = await self.osd.fanout_and_wait(
+                    [(peer, "backfill_reserve",
+                      {"pgid": self.pgid}, [])], collect=True, timeout=10)
+                if not replies or not replies[0].data.get("granted"):
+                    continue            # remote slot busy; next round
+                granted_remote = True
+                for oid in list(ms.items):
+                    await self._push_object(peer, oid)
+                if not ms:
+                    replies = await self.osd.fanout_and_wait(
+                        [(peer, "pg_backfill_done",
+                          {"pgid": self.pgid}, [])],
+                        collect=True, timeout=10)
+                    if replies and not replies[0].data.get("err"):
+                        self.backfill_targets.discard(peer)
+                        pinfo = self.peer_info.get(peer)
+                        if pinfo is not None:
+                            pinfo.backfill_complete = True
+            except asyncio.TimeoutError:
+                continue                # slot contention; retry next round
+            finally:
+                self.osd.local_reserver.release(token)
+                if granted_remote:
+                    try:
+                        await self.osd.fanout_and_wait(
+                            [(peer, "backfill_release",
+                              {"pgid": self.pgid}, [])],
+                            collect=True, timeout=5)
+                    except (ConnectionError, OSError,
+                            asyncio.TimeoutError):
+                        pass
 
     def _shard_of(self, osd_id: int) -> int:
         return self.acting.index(osd_id) if osd_id in self.acting else 0
@@ -601,6 +772,7 @@ class PG:
         sources = [o for o, pi in self.peer_info.items()
                    if self.osd.osd_is_up(o)
                    and pi.last_update >= need
+                   and pi.backfill_complete
                    and not self.peer_missing.get(
                        o, MissingSet()).is_missing(oid)]
         if not sources:

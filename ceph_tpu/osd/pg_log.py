@@ -98,6 +98,16 @@ class PGLog:
                 return e.version
         return self.tail
 
+    def overlaps(self, auth_info: PGInfo) -> bool:
+        """Can log-based recovery bridge us to this authoritative log?
+
+        False when our head predates the auth log's tail: entries in
+        the gap were trimmed there, so objects whose last modification
+        fell inside it would silently stay stale.  The caller must fall
+        back to whole-PG backfill (reference: last_backfill machinery,
+        PeeringState.h:645-680 Backfilling)."""
+        return self.head >= auth_info.log_tail
+
     def merge(self, auth_entries: list[LogEntry], auth_info: PGInfo,
               missing: MissingSet) -> list[LogEntry]:
         """Fold the authoritative log into ours (PGLog.h:1247 merge_log).
@@ -107,7 +117,23 @@ class PGLog:
         rewound; auth entries past it are appended and their objects
         marked missing until recovered.  Returns the divergent entries
         so the PG can clean up objects they created.
+
+        When the logs do NOT overlap (see overlaps()), the local log is
+        replaced wholesale: splicing across a gap would fabricate a
+        continuous history that hides trimmed modifications.  The caller
+        is responsible for scan-based backfill of the data.
         """
+        if not self.overlaps(auth_info):
+            self.entries = list(auth_entries)
+            self.tail = auth_info.log_tail
+            self.head = (auth_entries[-1].version if auth_entries
+                         else auth_info.last_update)
+            for e in auth_entries:
+                if e.is_delete():
+                    missing.items.pop(e.oid, None)
+                else:
+                    missing.add(e.oid, need=e.version, have=ZERO)
+            return []
         lu = self._last_common(auth_entries, auth_info.log_tail)
         divergent: list[LogEntry] = []
         if lu < self.head:

@@ -89,6 +89,11 @@ class PGInfo:
     log_tail: EVersion = ZERO             # oldest entry still in log
     last_epoch_started: int = 0
     same_interval_since: int = 0
+    # False while a scan-based whole-PG backfill is in flight: the log
+    # was adopted wholesale across a trim gap, so last_update overstates
+    # what the data actually holds (pg_info_t::last_backfill analog --
+    # True plays the role of last_backfill == MAX)
+    backfill_complete: bool = True
 
     def is_empty(self) -> bool:
         return not self.last_update
@@ -99,7 +104,8 @@ class PGInfo:
                 "last_complete": self.last_complete.to_list(),
                 "log_tail": self.log_tail.to_list(),
                 "last_epoch_started": self.last_epoch_started,
-                "same_interval_since": self.same_interval_since}
+                "same_interval_since": self.same_interval_since,
+                "backfill_complete": self.backfill_complete}
 
     @classmethod
     def from_dict(cls, d: dict) -> "PGInfo":
@@ -108,7 +114,8 @@ class PGInfo:
                    last_complete=EVersion.from_list(d["last_complete"]),
                    log_tail=EVersion.from_list(d["log_tail"]),
                    last_epoch_started=d.get("last_epoch_started", 0),
-                   same_interval_since=d.get("same_interval_since", 0))
+                   same_interval_since=d.get("same_interval_since", 0),
+                   backfill_complete=d.get("backfill_complete", True))
 
 
 class MissingSet:

@@ -64,8 +64,16 @@ class Cluster:
         return pgid, primary, up
 
     async def osd_op(self, pool_name, oid, ops, timeout=15, retries=40):
-        """Send ops to the current primary, retrying through peering."""
+        """Send ops to the current primary, retrying through peering.
+
+        The reqid is stable across retries of the same logical op (the
+        Objecter's osd_reqid_t discipline) so a delayed duplicate
+        delivery cannot re-apply an old write after newer ones.
+        """
         q = asyncio.Queue()
+        self._op_serial = getattr(self, "_op_serial", 0) + 1
+        reqid = [f"{self.client.name}:{self.client.incarnation}",
+                 self._op_serial]
 
         async def d(conn, msg):
             if msg.type == "osd_op_reply":
@@ -84,7 +92,7 @@ class Cluster:
                     await self.client.send(
                         tuple(addr), f"osd.{primary}",
                         Message("osd_op", {"pgid": pgid, "oid": oid,
-                                           "ops": meta},
+                                           "ops": meta, "reqid": reqid},
                                 segments=segs))
                     reply = await asyncio.wait_for(q.get(), timeout)
                 except (ConnectionError, OSError, asyncio.TimeoutError):

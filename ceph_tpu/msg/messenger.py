@@ -25,6 +25,17 @@ Dispatcher = Callable[["Connection", Message], Awaitable[None]]
 
 HELLO_MAGIC = b"CTHL"
 
+# flow-control policy (src/msg/Policy.h throttler analog): receivers ack
+# delivered seqs every ack_every messages or ack_bytes payload bytes --
+# and on a short idle timer, so a sender whose window is smaller than
+# the peer's batching cadence still gets unblocked -- and senders block
+# in send() once the unacked window exceeds the messenger's
+# max_unacked_msgs/max_unacked_bytes instead of growing without bound.
+ACK_EVERY = 64
+ACK_BYTES = 8 << 20
+ACK_FLUSH_S = 0.2
+ACK_TYPE = "__ack"
+
 
 class Connection:
     def __init__(self, messenger: "Messenger", peer_name: str,
@@ -38,23 +49,54 @@ class Connection:
         self.peer_addr = peer_addr
         self.out_seq = 0
         self.in_seq = 0
-        self.unacked: deque[Message] = deque()
+        self.unacked: deque[tuple[Message, int]] = deque()  # (msg, nbytes)
+        self.unacked_bytes = 0
+        self.acked_seq = 0           # peer-confirmed delivery watermark
+        self._ack_pending_msgs = 0   # receive side: delivered since last ack
+        self._ack_pending_bytes = 0
         self.closed = False
         self.generation = 0          # bumped per successful reconnect
         self._send_lock = asyncio.Lock()
         self._reconnect_lock = asyncio.Lock()
+        self._window_open = asyncio.Event()
+        self._window_open.set()
         self._read_task: asyncio.Task | None = None
+        self._ack_task: asyncio.Task | None = None
+
+    def _window_full(self) -> bool:
+        m = self.messenger
+        return (len(self.unacked) >= m.max_unacked_msgs
+                or self.unacked_bytes >= m.max_unacked_bytes)
+
+    def _trim_acked(self, seq: int) -> None:
+        if seq <= self.acked_seq:
+            return
+        self.acked_seq = seq
+        while self.unacked and self.unacked[0][0].seq <= seq:
+            _, nbytes = self.unacked.popleft()
+            self.unacked_bytes -= nbytes
+        if not self._window_full():
+            self._window_open.set()
 
     async def send(self, msg: Message) -> None:
         async with self._send_lock:
+            # window check INSIDE the lock: senders queued on the lock
+            # must re-check, or K concurrent sends overshoot the window
+            # by K-1.  Acks reopen from the read loop (no send lock), so
+            # waiting here cannot deadlock.
+            while self._window_full() and not self.closed:
+                self._window_open.clear()
+                await self._window_open.wait()
+            if self.closed:
+                raise ConnectionError(f"{self.peer_name} closed")
             self.out_seq += 1
             msg.seq = self.out_seq
             msg.from_name = self.messenger.name
-            self.unacked.append(msg)
-            if len(self.unacked) > 1024:
-                self.unacked.popleft()
+            buf = msg.encode()
+            self.unacked.append((msg, len(buf)))
+            self.unacked_bytes += len(buf)
             try:
-                self.writer.write(msg.encode())
+                self.writer.write(buf)
                 await self.writer.drain()
             except (ConnectionError, OSError):
                 if self.outgoing:
@@ -63,15 +105,50 @@ class Connection:
                     await self.close()
                     raise
 
+    def _note_delivered(self, nbytes: int) -> None:
+        """Receive side: count a delivery toward the ack cadence and
+        confirm immediately once the cadence is hit (a lost ack is
+        re-covered by the next one or the reconnect handshake)."""
+        self._ack_pending_msgs += 1
+        self._ack_pending_bytes += nbytes
+        if (self._ack_pending_msgs >= self.messenger.ack_every
+                or self._ack_pending_bytes >= self.messenger.ack_bytes):
+            self._flush_ack()
+        elif self._ack_task is None or self._ack_task.done():
+            # idle flush: a sender with a window smaller than our
+            # batching cadence must still see acks eventually
+            self._ack_task = asyncio.ensure_future(self._ack_flusher())
+
+    def _flush_ack(self) -> None:
+        self._ack_pending_msgs = 0
+        self._ack_pending_bytes = 0
+        ack = Message(ACK_TYPE, {"seq": self.in_seq})
+        ack.from_name = self.messenger.name
+        try:
+            self.writer.write(ack.encode())
+        except (ConnectionError, OSError):
+            pass
+
+    async def _ack_flusher(self) -> None:
+        try:
+            await asyncio.sleep(ACK_FLUSH_S)
+            if not self.closed and self._ack_pending_msgs:
+                self._flush_ack()
+        except asyncio.CancelledError:
+            pass
+
     async def _resend_unacked(self) -> None:
-        for msg in list(self.unacked):
+        for msg, _ in list(self.unacked):
             self.writer.write(msg.encode())
         await self.writer.drain()
 
     async def close(self) -> None:
         self.closed = True
+        self._window_open.set()      # wake throttled senders to error out
         if self._read_task:
             self._read_task.cancel()
+        if self._ack_task:
+            self._ack_task.cancel()
         try:
             self.writer.close()
         except Exception:
@@ -79,9 +156,17 @@ class Connection:
 
 
 class Messenger:
-    def __init__(self, name: str, secret: bytes | None = None) -> None:
+    def __init__(self, name: str, secret: bytes | None = None, *,
+                 max_unacked_msgs: int = 4096,
+                 max_unacked_bytes: int = 64 << 20,
+                 ack_every: int = ACK_EVERY,
+                 ack_bytes: int = ACK_BYTES) -> None:
         self.name = name
         self.secret = secret
+        self.max_unacked_msgs = max_unacked_msgs
+        self.max_unacked_bytes = max_unacked_bytes
+        self.ack_every = ack_every
+        self.ack_bytes = ack_bytes
         # incarnation distinguishes a restarted peer from a reconnecting
         # one (ProtocolV2's global_seq/connect_seq split): a new
         # incarnation resets the replay-dedup session, a reconnect of
@@ -189,7 +274,7 @@ class Messenger:
         # incoming conn per peer and would drop the losers mid-flight)
         lock = self._connect_locks.setdefault(peer_name, asyncio.Lock())
         async with lock:
-            replay: list[Message] = []
+            replay: list[Message] = []   # unacked msgs carried over
             conn = self.conns.get(peer_name)
             if conn is not None and not conn.closed:
                 if conn.outgoing and conn.peer_addr is not None \
@@ -197,12 +282,12 @@ class Messenger:
                     # peer rebound to a new address: the cached conn
                     # points at a dead endpoint; carry its unacked
                     # messages over (lossless policy)
-                    replay = list(conn.unacked)
+                    replay = [m for m, _ in conn.unacked]
                     await conn.close()
                 else:
                     return conn
             elif conn is not None and conn.closed:
-                replay = list(conn.unacked)
+                replay = [m for m, _ in conn.unacked]
             reader, writer = await asyncio.open_connection(
                 addr[0], addr[1])
             last_seq = await self._handshake_client(reader, writer)
@@ -241,8 +326,7 @@ class Messenger:
                     reader, writer = await asyncio.open_connection(
                         conn.peer_addr[0], conn.peer_addr[1])
                     last_seq = await self._handshake_client(reader, writer)
-                    while conn.unacked and conn.unacked[0].seq <= last_seq:
-                        conn.unacked.popleft()
+                    conn._trim_acked(last_seq)
                     conn.reader, conn.writer = reader, writer
                     # server->client stream restarts on the new accept
                     conn.in_seq = 0
@@ -269,11 +353,15 @@ class Messenger:
             while not conn.closed:
                 buf = await read_frame(conn.reader)
                 msg = Message.decode(buf)
+                if msg.type == ACK_TYPE:   # control frame, outside seq space
+                    conn._trim_acked(int(msg.data.get("seq", 0)))
+                    continue
                 if msg.seq <= conn.in_seq:
                     continue  # duplicate after resend
                 conn.in_seq = msg.seq
                 if not conn.outgoing:
                     self._sessions[conn.peer_name] = msg.seq
+                conn._note_delivered(len(buf))
                 # dispatch in a task: a handler that itself RPCs back to
                 # this peer must not block the read loop its reply rides
                 # on (the reference's DispatchQueue decoupling).  Task

@@ -72,11 +72,16 @@ class Cluster:
         """
         q = asyncio.Queue()
         self._op_serial = getattr(self, "_op_serial", 0) + 1
-        reqid = [f"{self.client.name}:{self.client.incarnation}",
-                 self._op_serial]
+        tid = self._op_serial
+        reqid = [f"{self.client.name}:{self.client.incarnation}", tid]
 
         async def d(conn, msg):
-            if msg.type == "osd_op_reply":
+            # match replies to THIS op by tid: concurrent osd_ops share
+            # the client, and an unfiltered dispatcher would hand one
+            # writer another writer's ack (a write acked-but-never-
+            # committed is exactly the corruption the thrasher hunts)
+            if (msg.type == "osd_op_reply"
+                    and msg.data.get("tid") == tid):
                 await q.put(msg)
 
         self.client.add_dispatcher(d)
@@ -92,7 +97,8 @@ class Cluster:
                     await self.client.send(
                         tuple(addr), f"osd.{primary}",
                         Message("osd_op", {"pgid": pgid, "oid": oid,
-                                           "ops": meta, "reqid": reqid},
+                                           "ops": meta, "reqid": reqid,
+                                           "tid": tid},
                                 segments=segs))
                     reply = await asyncio.wait_for(q.get(), timeout)
                 except (ConnectionError, OSError, asyncio.TimeoutError):

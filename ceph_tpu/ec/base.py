@@ -177,3 +177,13 @@ class ErasureCode(ErasureCodeInterface):
         chunk_size: int = 0,
     ) -> dict[int, np.ndarray]:
         return self._decode(want_to_read, chunks)
+
+    def decode_concat(self, chunks: Mapping[int, np.ndarray]) -> bytes:
+        """Reconstruct the object: data chunk i lives at shard
+        chunk_index(i) for mapped codes (ErasureCode::decode_concat
+        honours get_chunk_mapping the same way)."""
+        k = self.get_data_chunk_count()
+        want = {self.chunk_index(i) for i in range(k)}
+        decoded = self.decode(want, chunks)
+        return b"".join(bytes(decoded[self.chunk_index(i)])
+                        for i in range(k))

@@ -1,0 +1,280 @@
+"""LRC (locally repairable / layered) erasure-code plugin.
+
+Semantics follow the reference's lrc plugin
+(src/erasure-code/lrc/ErasureCodeLrc.h:47-134, ErasureCodeLrc.cc
+parse_kml/layers_parse/_minimum_to_decode): the code is a stack of
+layers, each a systematic RS sub-codec over a subset of the chunk
+positions.  A ``k/m/l`` profile generates the canonical layered layout:
+
+  local_group_count = (k + m) / l          # (k+m) % l == 0 required
+  per group: k/lgc data chunks, m/lgc global parities, 1 local parity
+
+The global layer computes the m global parities from all k data chunks;
+each local layer computes its group's local parity over the group's l
+chunks (data + global parities).  A single lost chunk is repaired from
+its local group's other l chunks only -- ``minimum_to_decode`` returns
+l shards, not k -- which is the whole point of the code: repair reads
+stay inside a failure domain (here: inside a mesh sub-axis, see
+ceph_tpu/parallel/sharded_ec.py lrc_local_repair).
+
+Arbitrary layerings are accepted via ``mapping`` + ``layers`` profile
+keys (layers as JSON ``[[mapping, profile], ...]``), mirroring
+ErasureCodeLrc::layers_parse.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Mapping
+
+import numpy as np
+
+from ...gf import build_decode_matrix, gf_matmul
+from ...gf.matrices import gen_rs_matrix, gen_cauchy1_matrix
+from ..base import ErasureCode
+from ..registry import ErasureCodePlugin
+
+DEFAULT_KML = -1
+
+
+class _Layer:
+    """One layer: a systematic RS code over a subset of positions."""
+
+    def __init__(self, mapping: str, technique: str = "reed_sol_van"):
+        self.mapping = mapping
+        self.data_pos = [i for i, c in enumerate(mapping) if c == "D"]
+        self.coding_pos = [i for i, c in enumerate(mapping) if c == "c"]
+        self.positions = self.data_pos + self.coding_pos
+        self.k = len(self.data_pos)
+        self.m = len(self.coding_pos)
+        if self.k < 1 or self.m < 1:
+            raise ValueError(f"layer {mapping!r} needs >=1 D and >=1 c")
+        gen = (gen_cauchy1_matrix if technique == "cauchy"
+               else gen_rs_matrix)
+        self.matrix = gen(self.k + self.m, self.k)
+
+    def encode_into(self, chunks: dict[int, np.ndarray]) -> None:
+        data = np.stack([chunks[p] for p in self.data_pos])
+        parity = gf_matmul(self.matrix[self.k:], data)
+        for r, p in enumerate(self.coding_pos):
+            chunks[p][:] = parity[r]
+
+    def recover(self, chunks: dict[int, np.ndarray],
+                missing: set[int]) -> list[int]:
+        """Decode this layer's missing chunks in place; returns the
+        positions recovered."""
+        mine = set(self.positions)
+        lost = sorted((missing & mine))
+        # local erasure indices within the layer's position ordering
+        pos_index = {p: i for i, p in enumerate(self.positions)}
+        erasures = [pos_index[p] for p in lost]
+        matrix, decode_index = build_decode_matrix(
+            self.matrix, self.k, erasures)
+        sources = np.stack([chunks[self.positions[i]]
+                            for i in decode_index])
+        recovered = gf_matmul(matrix, sources)
+        for r, p in enumerate(lost):
+            chunks[p] = recovered[r].copy()
+        return lost
+
+
+class ErasureCodeLrc(ErasureCode):
+    def __init__(self) -> None:
+        super().__init__()
+        self.k = 0
+        self.m = 0
+        self.l = 0
+        self.mapping = ""
+        self.layers: list[_Layer] = []
+        self.chunk_count_ = 0
+
+    # -- profile ------------------------------------------------------------
+    def _parse_kml(self, profile) -> None:
+        k = self.to_int("k", profile, str(DEFAULT_KML))
+        m = self.to_int("m", profile, str(DEFAULT_KML))
+        l = self.to_int("l", profile, str(DEFAULT_KML))
+        present = [v != DEFAULT_KML for v in (k, m, l)]
+        if not any(present):
+            return
+        if not all(present):
+            raise ValueError("all of k, m, l must be set or none")
+        for key in ("mapping", "layers"):
+            if profile.get(key):
+                raise ValueError(
+                    f"{key} cannot be set when k/m/l are set")
+        if l == 0 or (k + m) % l:
+            raise ValueError(f"k+m={k + m} must be a multiple of l={l}")
+        lgc = (k + m) // l
+        if k % lgc:
+            raise ValueError(f"k={k} must be a multiple of (k+m)/l={lgc}")
+        if m % lgc:
+            raise ValueError(f"m={m} must be a multiple of (k+m)/l={lgc}")
+        self.k, self.m, self.l = k, m, l
+        kg, mg = k // lgc, m // lgc
+        # mapping: per group D*kg + _*mg (global parities) + _ (local)
+        profile["mapping"] = ("D" * kg + "_" * mg + "_") * lgc
+        layers = [["".join(("D" * kg + "c" * mg + "_")
+                           for _ in range(lgc)), ""]]
+        for i in range(lgc):
+            row = []
+            for j in range(lgc):
+                row.append("D" * (kg + mg) + "c" if i == j
+                           else "_" * (kg + mg + 1))
+            layers.append(["".join(row), ""])
+        profile["layers"] = json.dumps(layers)
+
+    def _parse_layers(self, profile) -> None:
+        raw = profile.get("layers", "")
+        if not raw:
+            raise ValueError("lrc: profile needs layers or k/m/l")
+        try:
+            spec = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"lrc: layers is not valid JSON: {e}")
+        mapping = profile.get("mapping", "")
+        if not mapping:
+            raise ValueError("lrc: mapping is required with layers")
+        self.mapping = mapping
+        self.chunk_count_ = len(mapping)
+        self.layers = []
+        for entry in spec:
+            lmap = entry[0] if isinstance(entry, list) else str(entry)
+            lprofile = (entry[1] if isinstance(entry, list)
+                        and len(entry) > 1 else "")
+            technique = "reed_sol_van"
+            if isinstance(lprofile, dict):
+                technique = lprofile.get("technique", technique)
+            elif "cauchy" in str(lprofile):
+                technique = "cauchy"
+            if len(lmap) != len(mapping):
+                raise ValueError(
+                    f"lrc: layer {lmap!r} length != mapping length "
+                    f"{len(mapping)}")
+            self.layers.append(_Layer(lmap, technique))
+        data_pos = [i for i, c in enumerate(mapping) if c == "D"]
+        if self.k == 0:
+            self.k = len(data_pos)
+        # sanity: every non-data position is computed by some layer
+        computed = set()
+        for layer in self.layers:
+            computed |= set(layer.coding_pos)
+        uncovered = (set(range(self.chunk_count_)) - set(data_pos)
+                     - computed)
+        if uncovered:
+            raise ValueError(
+                f"lrc: positions {sorted(uncovered)} are neither data "
+                f"nor computed by any layer")
+
+    def init(self, profile) -> None:
+        self._parse_kml(profile)
+        self._parse_layers(profile)
+        self.parse(profile)        # builds chunk_mapping from mapping
+        super().init(profile)
+
+    # -- interface ----------------------------------------------------------
+    def get_chunk_count(self) -> int:
+        return self.chunk_count_
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        for layer in self.layers:
+            layer.encode_into(chunks)
+
+    # -- locality-aware minimum_to_decode -----------------------------------
+    def _repair_plan(self, want_to_read: set[int],
+                     available: set[int]) -> tuple[set[int], list[int]]:
+        """Greedy layered-repair closure.
+
+        Returns (chunks to read, layer application order).  Prefers the
+        layer that recovers a missing chunk with the FEWEST reads (the
+        local group before the global layer), mirroring
+        ErasureCodeLrc::_minimum_to_decode's locality preference.
+        """
+        wanted_missing = set(want_to_read) - set(available)
+        if not wanted_missing:
+            return set(want_to_read), []
+        virtual_avail = set(available)
+        reads: set[int] = set()
+        order: list[int] = []
+
+        def apply_layer(li: int) -> None:
+            layer = self.layers[li]
+            mine = set(layer.positions)
+            have = virtual_avail & mine
+            # the sub-decode reads the first k surviving chunks in the
+            # layer's position order
+            pos_index = {p: i for i, p in enumerate(layer.positions)}
+            erasures = {pos_index[p] for p in mine - have}
+            surviving = [p for p in layer.positions
+                         if pos_index[p] not in erasures][:layer.k]
+            reads.update(p for p in surviving if p in available)
+            virtual_avail.update(mine - have)
+            order.append(li)
+
+        def feasible(li: int, need: set[int]) -> bool:
+            layer = self.layers[li]
+            mine = set(layer.positions)
+            if not (need & mine):
+                return False
+            have = virtual_avail & mine
+            return len(mine - have) <= layer.m and len(have) >= layer.k
+
+        # smallest layer first = locality preference (a local group
+        # beats the global layer when both can repair)
+        by_size = sorted(range(len(self.layers)),
+                         key=lambda i: len(self.layers[i].positions))
+        for _ in range(len(self.layers) * (self.chunk_count_ + 1)):
+            still = wanted_missing - virtual_avail
+            if not still:
+                break
+            li = next((i for i in by_size if feasible(i, still)), None)
+            if li is None:
+                # no layer reaches a WANTED chunk directly: repairing
+                # some other missing chunk may unlock one (e.g. a local
+                # group fixing its loss lowers the global layer's
+                # erasure count)
+                other = set(range(self.chunk_count_)) - virtual_avail
+                li = next((i for i in by_size if feasible(i, other)),
+                          None)
+            if li is None:
+                raise IOError(
+                    f"lrc: cannot repair {sorted(still)} from "
+                    f"{sorted(available)}")
+            apply_layer(li)
+        wanted_reads = {p for p in want_to_read if p in available}
+        return reads | wanted_reads, order
+
+    def _minimum_to_decode(self, want_to_read: set[int],
+                           available_chunks: set[int]) -> set[int]:
+        reads, _ = self._repair_plan(want_to_read, available_chunks)
+        return reads
+
+    def decode_chunks(self, want_to_read: set[int],
+                      chunks: Mapping[int, np.ndarray],
+                      decoded: dict[int, np.ndarray]) -> None:
+        available = set(chunks)
+        _, order = self._repair_plan(set(want_to_read), available)
+        work = {p: np.array(v, dtype=np.uint8)
+                for p, v in decoded.items() if p in available}
+        recovered_all = set(available)
+        for li in order:
+            layer = self.layers[li]
+            got = layer.recover(work, set(range(self.chunk_count_))
+                                - recovered_all)
+            recovered_all |= set(got)
+        for p in want_to_read:
+            if p not in available:
+                decoded[p][:] = work[p]
+
+    def get_alignment(self) -> int:
+        return 32
+
+
+def _factory(profile):
+    return ErasureCodeLrc()
+
+
+def __erasure_code_init__(registry, name: str) -> None:
+    registry.add(name, ErasureCodePlugin(_factory))

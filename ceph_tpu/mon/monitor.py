@@ -443,11 +443,18 @@ class Monitor:
                     raise ValueError(f"no EC profile {profile_name}")
                 profile = dict(DEFAULT_EC_PROFILE)
                 inc.new_ec_profiles["default"] = profile
-            k = int(profile.get("k", 2))
-            m = int(profile.get("m", 1))
+            # pool width comes from the PLUGIN, not k+m: layered codes
+            # (lrc) add local parity chunks beyond k+m (the reference
+            # sizes pools via the instantiated codec the same way,
+            # OSDMonitor::get_erasure_code -> get_chunk_count)
+            codec = ec_registry().factory(
+                profile.get("plugin", "tpu"),
+                {pk: pv for pk, pv in profile.items() if pk != "plugin"})
+            width = codec.get_chunk_count()
+            k = codec.get_data_chunk_count()
             spec = PoolSpec(pool_id=pool_id, name=name,
-                            type=POOL_TYPE_ERASURE, size=k + m,
-                            min_size=k + 1 if m > 1 else k,
+                            type=POOL_TYPE_ERASURE, size=width,
+                            min_size=k + 1 if width - k > 1 else k,
                             pg_num=pg_num, pgp_num=pg_num, crush_rule=1,
                             erasure_code_profile=profile_name)
         else:

@@ -79,6 +79,8 @@ class Connection:
             self._window_open.set()
 
     async def send(self, msg: Message) -> None:
+        if msg.type == ACK_TYPE:
+            raise ValueError(f"{ACK_TYPE} is a reserved control frame type")
         async with self._send_lock:
             # window check INSIDE the lock: senders queued on the lock
             # must re-check, or K concurrent sends overshoot the window
@@ -381,8 +383,12 @@ class Messenger:
                     t.add_done_callback(self._accept_tasks.discard)
                 except RuntimeError:      # event loop shutting down
                     conn.closed = True
+                    conn._window_open.set()
             else:
                 conn.closed = True
+                # wake any sender blocked on the flow-control window so
+                # it raises instead of hanging on a dead connection
+                conn._window_open.set()
                 try:
                     conn.writer.close()
                 except Exception:

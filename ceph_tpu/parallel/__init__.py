@@ -8,6 +8,9 @@ all_gather/psum instead of a message fan-out.
 """
 
 from .sharded_ec import (  # noqa: F401
+    lrc_make_mesh,
+    lrc_sharded_encode,
+    lrc_sharded_local_repair,
     make_mesh,
     sharded_encode,
     sharded_ec_step,

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from ..gf import build_decode_matrix, gen_rs_matrix
 from ..ops.gf2kernels import bitmatrix_i8
 
 
@@ -123,3 +124,105 @@ def sharded_ec_step(mesh: Mesh, encode_matrix: np.ndarray,
         out_specs=P("stripe"),
     )(recovered)
     return parity, recovered, csum
+
+
+# -- LRC over mesh sub-axes --------------------------------------------------
+#
+# The locality structure of an LRC code (ec/plugins/lrc.py; reference
+# src/erasure-code/lrc/ErasureCodeLrc.h:47-134) maps onto the device mesh:
+# each local group lives on one slice of the 'group' axis.  Encoding the
+# global parities needs all k data chunks once (all_gather over 'group',
+# the ICI hop); local parities and -- the whole point -- single-shard
+# REPAIR are computed entirely inside the group's mesh slice with no
+# collective at all.  This is the TPU rendering of "repair reads stay
+# inside the failure domain".
+
+
+def lrc_make_mesh(n_devices: int, n_groups: int) -> Mesh:
+    """(stripe, group) mesh: group axis carries the LRC local groups."""
+    devs = np.asarray(jax.devices()[:n_devices])
+    return Mesh(devs.reshape(n_devices // n_groups, n_groups),
+                ("stripe", "group"))
+
+
+def lrc_sharded_encode(mesh: Mesh, k: int, m: int, l: int,
+                       data: jnp.ndarray) -> jnp.ndarray:
+    """LRC k/m/l encode over a (stripe, group) mesh.
+
+    ``data`` is (B, n_groups, kg, L): group-major data chunks, sharded
+    P('stripe', 'group', None, None).  Returns (B, n_groups, kg+mg+1, L)
+    full group-major chunk layout (data + global parity slots + local
+    parity), same sharding.  Byte-identical to the host `lrc` plugin's
+    encode for the k/m/l profile.
+    """
+    lgc = (k + m) // l
+    kg, mg = k // lgc, m // lgc
+    gen_g = gen_rs_matrix(k + m, k)          # global layer
+    gen_l = gen_rs_matrix(l + 1, l)          # local layers (m=1)
+    wg = jnp.asarray(bitmatrix_i8(gen_g[k:]))      # (8m, 8k)
+    wl = jnp.asarray(bitmatrix_i8(gen_l[l:]))      # (8, 8l)
+
+    def block(wg_all, wl_all, chunks):
+        # chunks: (B_loc, 1, kg, L) = my group's data shard
+        bl, _, _, ll = chunks.shape
+        gidx = jax.lax.axis_index("group")
+        # ICI hop: every group needs all k data chunks for its global
+        # parity rows
+        gathered = jax.lax.all_gather(
+            chunks, "group", axis=1, tiled=True)   # (B_loc, lgc, kg, L)
+        flat = gathered.reshape(bl, k, ll).transpose(1, 0, 2) \
+                       .reshape(k, bl * ll)
+        # my mg rows of the global parity (rows gidx*mg ..)
+        wg_mine = jax.lax.dynamic_slice_in_dim(
+            wg_all, gidx * 8 * mg, 8 * mg, axis=0)
+        gp = _gf_matmul_bits(wg_mine, flat)        # (mg, B*L)
+        gp = gp.reshape(mg, bl, ll).transpose(1, 0, 2)  # (B_loc, mg, L)
+        # local parity over my l = kg+mg chunks, no collective
+        mine = chunks[:, 0]                        # (B_loc, kg, L)
+        lchunks = jnp.concatenate([mine, gp], axis=1)   # (B_loc, l, L)
+        lflat = lchunks.transpose(1, 0, 2).reshape(l, bl * ll)
+        lp = _gf_matmul_bits(wl_all, lflat)
+        lp = lp.reshape(1, bl, ll).transpose(1, 0, 2)
+        out = jnp.concatenate([mine, gp, lp], axis=1)  # (B_loc, l+1, L)
+        return out[:, None]
+
+    fn = shard_map(
+        block, mesh=mesh,
+        in_specs=(P(None, None), P(None, None),
+                  P("stripe", "group", None, None)),
+        out_specs=P("stripe", "group", None, None),
+    )
+    return fn(wg, wl, data)
+
+
+def lrc_sharded_local_repair(mesh: Mesh, k: int, m: int, l: int,
+                             lost_local_pos: int,
+                             chunks: jnp.ndarray) -> jnp.ndarray:
+    """Repair ONE lost chunk per group from the group's surviving l
+    chunks -- no collective: the repair never leaves the mesh slice.
+
+    ``chunks``: (B, n_groups, l+1, L) group-major layout from
+    lrc_sharded_encode; ``lost_local_pos`` in [0, l+1) names the lost
+    position within every group (the dry run loses the same local slot
+    in each group; per-group positions would shard the decode matrix).
+    Returns (B, n_groups, 1, L): the reconstructed chunk per group.
+    """
+    gen_l = gen_rs_matrix(l + 1, l)
+    dec, idx = build_decode_matrix(gen_l, l, [lost_local_pos])
+    wd = jnp.asarray(bitmatrix_i8(dec))            # (8, 8l)
+    sel = jnp.asarray(idx)
+
+    def block(wd_all, chunks_):
+        bl, _, _, ll = chunks_.shape
+        mine = chunks_[:, 0]                       # (B_loc, l+1, L)
+        srcs = mine[:, sel]                        # (B_loc, l, L)
+        flat = srcs.transpose(1, 0, 2).reshape(l, bl * ll)
+        rec = _gf_matmul_bits(wd_all, flat)
+        return rec.reshape(1, bl, ll).transpose(1, 0, 2)[:, None]
+
+    fn = shard_map(
+        block, mesh=mesh,
+        in_specs=(P(None, None), P("stripe", "group", None, None)),
+        out_specs=P("stripe", "group", None, None),
+    )
+    return fn(wd, chunks)

@@ -142,3 +142,107 @@ def test_chunk_mapping_profile(registry):
     assert np.array_equal(encoded[1], arr[0])
     assert np.array_equal(encoded[2], arr[1])
     assert np.array_equal(encoded[0], arr[0] ^ arr[1])
+
+
+# -- LRC ---------------------------------------------------------------------
+
+def test_lrc_kml_layout(registry):
+    """Canonical doc example k=4 m=2 l=3: two local groups of DD+gp+lp,
+    generated mapping/layers per ErasureCodeLrc::parse_kml."""
+    codec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    # lgc=2 groups: mapping per group = DD + _ + _ -> "DD__DD__"
+    assert codec.get_profile()["mapping"] == "DD__DD__"
+    assert codec.get_chunk_count() == 8     # 4 data + 2 global + 2 local
+    assert codec.get_data_chunk_count() == 4
+
+
+def test_lrc_kml_validation(registry):
+    with pytest.raises(ValueError):
+        registry.factory("lrc", {"k": "4", "m": "2", "l": "4"})  # (k+m)%l
+    with pytest.raises(ValueError):
+        registry.factory("lrc", {"k": "4", "m": "2"})  # all-or-nothing
+    with pytest.raises(ValueError):
+        registry.factory("lrc", {"k": "5", "m": "1", "l": "3"})  # k%lgc
+
+
+def test_lrc_roundtrip_all_single_erasures(registry):
+    codec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    data = rand_bytes(4 * 96, seed=7)
+    chunks = codec.encode(set(range(n)), data)
+    for lost in range(n):
+        have = {i: chunks[i] for i in range(n) if i != lost}
+        dec = codec.decode({lost}, have)
+        assert np.array_equal(dec[lost], chunks[lost]), lost
+
+
+def test_lrc_single_loss_repairs_locally(registry):
+    """The locality property: one lost chunk is repaired from its own
+    group's l chunks, NOT from k chunks (ErasureCodeLrc.h:47-134)."""
+    codec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    # groups on positions: [0,1,2,3] and [4,5,6,7] (DD c local | DD c local)
+    for lost in range(n):
+        avail = set(range(n)) - {lost}
+        plan = codec.minimum_to_decode({lost}, avail)
+        group = 0 if lost < 4 else 1
+        group_pos = set(range(4 * group, 4 * group + 4))
+        assert set(plan) <= group_pos - {lost}, (lost, plan)
+        assert len(plan) == 3  # l chunks, not k+... reads
+       
+
+def test_lrc_double_loss_same_group_uses_global(registry):
+    codec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    data = rand_bytes(4 * 96, seed=9)
+    chunks = codec.encode(set(range(n)), data)
+    # two data chunks in group 0 lost: local parity (m=1) can't fix;
+    # the global layer must engage
+    for lost in ([0, 1], [0, 4], [1, 5], [2, 6]):
+        have = {i: chunks[i] for i in range(n) if i not in lost}
+        dec = codec.decode(set(lost), have)
+        for p in lost:
+            assert np.array_equal(dec[p], chunks[p]), (lost, p)
+
+
+def test_lrc_triple_loss_mixed(registry):
+    """Local repair in one group + global repair across groups."""
+    codec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    data = rand_bytes(4 * 96, seed=11)
+    chunks = codec.encode(set(range(n)), data)
+    lost = [0, 1, 4]      # 2 in group 0 (needs global), 1 in group 1
+    have = {i: chunks[i] for i in range(n) if i not in lost}
+    dec = codec.decode(set(lost), have)
+    for p in lost:
+        assert np.array_equal(dec[p], chunks[p]), p
+
+
+def test_lrc_beyond_capability_raises(registry):
+    codec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    # 3 losses inside one 4-chunk group: local m=1 + global m=2 on the
+    # group's 3 affected global positions -> unrecoverable
+    avail = set(range(n)) - {0, 1, 2}
+    with pytest.raises(IOError):
+        codec.minimum_to_decode({0, 1, 2}, avail)
+
+
+def test_lrc_baseline_config_k12_m4_l4(registry):
+    """The multi-chip BASELINE shape: 4 local groups mapping onto a
+    4-way mesh axis (parallel/sharded_ec.py lrc_local_repair)."""
+    codec = registry.factory("lrc", {"k": "12", "m": "4", "l": "4"})
+    n = codec.get_chunk_count()
+    assert n == 12 + 4 + 4
+    data = rand_bytes(12 * 64, seed=13)
+    chunks = codec.encode(set(range(n)), data)
+    # single loss in each group repairs group-locally (l=4 reads)
+    for lost in (0, 5, 12, 19):
+        avail = set(range(n)) - {lost}
+        plan = codec.minimum_to_decode({lost}, avail)
+        group = lost // 5
+        group_pos = set(range(5 * group, 5 * group + 5))
+        assert set(plan) <= group_pos - {lost}
+        assert len(plan) == 4
+        dec = codec.decode({lost}, {i: chunks[i] for i in avail})
+        assert np.array_equal(dec[lost], chunks[lost])

@@ -144,6 +144,11 @@ class PGBackend:
     async def read_recovery_payload(self, oid: str, shard: int) -> dict:
         raise NotImplementedError
 
+    def invalidate_extents(self, oid: str | None = None) -> None:
+        """Shard content changed outside the write path (recovery push,
+        backfill, peering reset): drop any cached extents.  No-op for
+        backends without a cache."""
+
 
 def build_pg_backend(pg):
     """PGBackend.cc:570 — pool type picks the backend."""
@@ -215,9 +220,11 @@ class ECBackend(PGBackend):
         profile = dict(pg.ec_profile)
         plugin = profile.pop("plugin", "tpu")
         from ..ec import registry
+        from .extent_cache import ExtentCache
         self.codec = registry().factory(plugin, profile)
         self.sinfo = StripeInfo.for_codec(
             self.codec, stripe_unit=int(profile.get("stripe_unit", 4096)))
+        self.cache = ExtentCache()
 
     @property
     def k(self) -> int:
@@ -226,11 +233,21 @@ class ECBackend(PGBackend):
     def my_shard(self) -> int:
         return self.pg.acting.index(self.osd.whoami)
 
+    def invalidate_extents(self, oid: str | None = None) -> None:
+        if oid is None:
+            self.cache.clear()
+        else:
+            self.cache.invalidate(oid)
+
     # -- logical object reconstruction --------------------------------------
-    def _local_shard(self, oid: str):
-        """(buf, size, version) for my shard; absent -> (b'', 0, (0,0))."""
+    def _local_shard(self, oid: str, rng: tuple[int, int] | None = None):
+        """(buf, size, version) for my shard; absent -> (b'', 0, (0,0)).
+
+        ``rng`` = (chunk_off, chunk_len) reads only that slice of the
+        shard (the partial-stripe RMW read phase)."""
+        off, length = rng if rng else (0, None)
         try:
-            raw = self.store.read(self.coll, oid, 0, None)
+            raw = self.store.read(self.coll, oid, off, length)
         except FileNotFoundError:
             raw = b""
         sx = self.store.getattr(self.coll, oid, SIZE_XATTR)
@@ -238,7 +255,8 @@ class ECBackend(PGBackend):
         return np.frombuffer(raw, np.uint8), int(sx) if sx else 0, ver
 
     async def _fetch_shards(self, oid: str, shards: list[int],
-                            avail: dict[int, int]) -> dict:
+                            avail: dict[int, int],
+                            rng: tuple[int, int] | None = None) -> dict:
         """Fetch several shards' (buf, size, ver) with ONE parallel
         fanout (the hot read path: serial round trips would multiply
         latency by k)."""
@@ -246,13 +264,16 @@ class ECBackend(PGBackend):
         remote = []
         for s in shards:
             if avail[s] == self.osd.whoami:
-                out[s] = self._local_shard(oid)
+                out[s] = self._local_shard(oid, rng)
             else:
                 remote.append(s)
         if remote:
+            payload = {"pgid": self.pg.pgid, "oid": oid}
+            if rng is not None:
+                payload["off"], payload["len"] = rng
             replies = await self.osd.fanout_and_wait(
-                [(avail[s], "ec_subop_read",
-                  {"pgid": self.pg.pgid, "oid": oid}, []) for s in remote],
+                [(avail[s], "ec_subop_read", dict(payload), [])
+                 for s in remote],
                 collect=True)
             for rep in replies:
                 s = rep.data.get("shard")
@@ -269,7 +290,8 @@ class ECBackend(PGBackend):
         return out
 
     async def _gather_shards(self, oid: str,
-                             need_shards: set[int] | None = None
+                             need_shards: set[int] | None = None,
+                             rng: tuple[int, int] | None = None
                              ) -> tuple[dict[int, np.ndarray], int]:
         """Read enough CONSISTENT shard buffers to decode.
 
@@ -286,21 +308,26 @@ class ECBackend(PGBackend):
         for shard, osd in enumerate(acting):
             if osd >= 0 and self.osd.osd_is_up(osd):
                 avail[shard] = osd
-        want = need_shards or set(range(self.k))
+        want = need_shards or set(self.sinfo.data_positions(self.codec))
         fetched: dict[int, tuple[np.ndarray, int, tuple]] = {}
         rejected: set[int] = set()
         for _ in range(len(acting) + 1):
             usable = set(avail) - rejected
             plan = set(self.codec.minimum_to_decode(want, usable))
             fetched.update(await self._fetch_shards(
-                oid, sorted(plan - set(fetched)), avail))
+                oid, sorted(plan - set(fetched)), avail, rng))
             vers = {s: fetched[s][2] for s in plan}
             newest = max(vers.values())
             stale = {s for s, v in vers.items() if v < newest}
             if not stale:
                 bufs = {s: fetched[s][0] for s in plan}
                 size = max((fetched[s][1] for s in plan), default=0)
-                shard_len = max((len(b) for b in bufs.values()), default=0)
+                # ranged reads must pad every shard to the full range so
+                # decode sees aligned slices (a short read = the shard
+                # file ends inside the range; logical zeros beyond)
+                shard_len = (rng[1] if rng is not None else
+                             max((len(b) for b in bufs.values()),
+                                 default=0))
                 for s, b in list(bufs.items()):
                     if len(b) < shard_len:
                         nb = np.zeros(shard_len, np.uint8)
@@ -349,6 +376,12 @@ class ECBackend(PGBackend):
             if awaiting:
                 await self.osd.fanout_and_wait(awaiting)
             return
+        old_size = await self.object_size(entry.oid)
+        plan = self._plan_rmw(content_muts, old_size)
+        if plan is not None:
+            await self._submit_partial(entry, content_muts, attr_muts,
+                                       old_size, *plan)
+            return
         logical = bytearray(await self._read_logical(entry.oid))
         remove = False          # tracks the FINAL state: a remove followed
         for m in content_muts:  # by a write recreates the object in-order
@@ -373,6 +406,7 @@ class ECBackend(PGBackend):
 
         acting = self.pg.acting
         if remove:
+            self.cache.invalidate(entry.oid)
             per_shard = [{"remove": True} for _ in acting]
             segs_per_shard = [[] for _ in acting]
         else:
@@ -384,6 +418,16 @@ class ECBackend(PGBackend):
             else:
                 shards = {i: np.zeros(0, np.uint8)
                           for i in range(len(acting))}
+            sw = self.sinfo.stripe_width
+            self.cache.truncate_beyond(entry.oid, len(padded) // sw)
+            if len(padded) <= self.cache.max_bytes // 4:
+                for s in range(len(padded) // sw):
+                    self.cache.put(entry.oid, s,
+                                   padded[s * sw:(s + 1) * sw])
+            else:
+                # a huge rewrite would churn the whole LRU for entries
+                # that mostly evict each other; drop stale ones instead
+                self.cache.invalidate(entry.oid)
             per_shard, segs_per_shard = [], []
             for shard in range(len(acting)):
                 buf = shards[shard].tobytes()
@@ -408,12 +452,175 @@ class ECBackend(PGBackend):
         if awaiting:
             await self.osd.fanout_and_wait(awaiting)
 
+    # -- partial-stripe RMW pipeline ----------------------------------------
+    # The reference's RMWPipeline (ECCommon.cc:704 start_rmw ->
+    # try_state_to_reads -> try_reads_to_commit): only the stripes a
+    # write touches are read, merged, re-encoded and shipped as ranged
+    # per-shard sub-writes, so a 4KiB overwrite of a huge object moves
+    # O(stripe), not O(object).  The ExtentCache feeds the read phase
+    # for stripes a recent write already materialized.
+
+    def _plan_rmw(self, muts: list[dict],
+                  old_size: int) -> tuple[int, list[int]] | None:
+        """(new_size, touched stripe indices) for the partial path, or
+        None when the full-object path is required (truncate/remove
+        chains, fresh objects, or writes covering everything)."""
+        if old_size == 0:
+            return None
+        sw = self.sinfo.stripe_width
+        size = old_size
+        touched: set[int] = set()
+        for m in muts:          # content_muts: create is pre-filtered
+            op = m["op"]
+            if op == "write":
+                data, off = m["data"], m["off"]
+                # empty writes still extend to `off` (the full path's
+                # bytearray-extend semantics); they just touch nothing
+                if data:
+                    end = off + len(data)
+                    touched.update(range(off // sw, (end - 1) // sw + 1))
+                size = max(size, off + len(data))
+            elif op == "zero":
+                # clamp to the RUNNING size: a zero may target a region
+                # an earlier write in this op vector just extended
+                end = min(m["off"] + m["len"], size)
+                if end > m["off"]:
+                    touched.update(range(m["off"] // sw,
+                                         (end - 1) // sw + 1))
+            else:               # truncate / remove: full path
+                return None
+        if not touched:
+            return None
+        n_stripes = (self.sinfo.logical_to_next_stripe_offset(size) // sw)
+        if len(touched) >= n_stripes:
+            return None         # rewriting everything anyway
+        return size, sorted(touched)
+
+    @staticmethod
+    def _runs(stripes: list[int]) -> list[tuple[int, int]]:
+        """Contiguous [lo, hi] inclusive runs of sorted stripe indices."""
+        runs: list[tuple[int, int]] = []
+        for s in stripes:
+            if runs and s == runs[-1][1] + 1:
+                runs[-1] = (runs[-1][0], s)
+            else:
+                runs.append((s, s))
+        return runs
+
+    async def _read_stripes(self, oid: str, stripes: list[int],
+                            old_size: int) -> dict[int, bytearray]:
+        """Old logical content of ``stripes``: ExtentCache first, then
+        ranged shard gathers (degraded-safe: _gather_shards picks shards
+        via minimum_to_decode and decodes when data shards are down)."""
+        sw, cs = self.sinfo.stripe_width, self.sinfo.chunk_size
+        n_old = self.sinfo.logical_to_next_stripe_offset(old_size) // sw
+        dpos = self.sinfo.data_positions(self.codec)
+        out: dict[int, bytearray] = {}
+        misses: list[int] = []
+        for s in stripes:
+            if s >= n_old:
+                out[s] = bytearray(sw)       # beyond old EOF: zeros
+                continue
+            c = self.cache.get(oid, s)
+            if c is not None:
+                out[s] = bytearray(c)
+            else:
+                misses.append(s)
+        for lo, hi in self._runs(misses):
+            rng = (lo * cs, (hi - lo + 1) * cs)
+            bufs, _, _ = await self._gather_shards(oid, rng=rng)
+            data_shards = self.sinfo.decode(self.codec, bufs,
+                                            want=set(dpos))
+            for i, s in enumerate(range(lo, hi + 1)):
+                parts = [data_shards[p][i * cs:(i + 1) * cs]
+                         for p in dpos]
+                out[s] = bytearray(b"".join(
+                    np.asarray(p).tobytes() for p in parts))
+        return out
+
+    async def _submit_partial(self, entry, content_muts: list[dict],
+                              attr_muts: list[dict], old_size: int,
+                              new_size: int, stripes: list[int]) -> None:
+        oid = entry.oid
+        sw, cs = self.sinfo.stripe_width, self.sinfo.chunk_size
+        stripe_data = await self._read_stripes(oid, stripes, old_size)
+        # merge the mutations into the touched stripes; `cur` tracks the
+        # running logical size so a zero clamps against what earlier
+        # writes in this vector extended, not the stale old_size
+        cur = old_size
+        for m in content_muts:
+            if m["op"] == "write":
+                off, data = m["off"], m["data"]
+                end = off + len(data)
+                cur = max(cur, end)
+            elif m["op"] == "zero":
+                off = m["off"]
+                end = min(off + m["len"], cur)
+                data = None
+            else:
+                continue
+            for s in stripes:
+                lo, hi = s * sw, (s + 1) * sw
+                a, b = max(off, lo), min(end, hi)
+                if a >= b:
+                    continue
+                if data is None:
+                    stripe_data[s][a - lo:b - lo] = b"\0" * (b - a)
+                else:
+                    stripe_data[s][a - lo:b - lo] = data[a - off:b - off]
+        # encode each contiguous run in one driver call; collect ranged
+        # per-shard writes
+        acting = self.pg.acting
+        shard_writes: list[list[tuple[int, bytes]]] = [
+            [] for _ in acting]
+        for lo, hi in self._runs(stripes):
+            blob = b"".join(bytes(stripe_data[s])
+                            for s in range(lo, hi + 1))
+            shards = self.sinfo.encode(self.codec, blob)
+            for shard in range(len(acting)):
+                shard_writes[shard].append(
+                    (lo * cs, shards[shard].tobytes()))
+        for s in stripes:
+            self.cache.put(oid, s, bytes(stripe_data[s]))
+        shard_len = self.sinfo.object_size_to_shard_size(new_size)
+        attr_meta, attr_segs = pack_mutations(attr_muts)
+        awaiting = []
+        for shard, osd in enumerate(acting):
+            if osd < 0:
+                continue
+            w = {"size": new_size, "shard_len": shard_len,
+                 "writes": [[off, len(buf)]
+                            for off, buf in shard_writes[shard]]}
+            segs = [buf for _, buf in shard_writes[shard]]
+            if osd == self.osd.whoami:
+                self.apply_sub_write(entry, w, segs, attr_muts)
+            else:
+                payload = {"pgid": self.pg.pgid, "oid": oid,
+                           "shard": shard, "entry": entry.to_dict(),
+                           "w": w, "attr_muts": attr_meta}
+                awaiting.append((osd, "ec_subop_write", payload,
+                                 segs + attr_segs))
+        if awaiting:
+            await self.osd.fanout_and_wait(awaiting)
+
     def apply_sub_write(self, entry: LogEntry, w: dict,
                         segs: list[bytes], attr_muts: list[dict]) -> None:
         txn = Transaction()
         oid = entry.oid
         if w.get("remove"):
             txn.remove(self.coll, oid)
+        elif w.get("writes") is not None:
+            # partial-stripe RMW: ranged chunk writes + final length
+            txn.touch(self.coll, oid)
+            for i, (off, ln) in enumerate(w["writes"]):
+                buf = segs[i] if i < len(segs) else b""
+                assert len(buf) == ln, (len(buf), ln)
+                txn.write(self.coll, oid, off, buf)
+            txn.truncate(self.coll, oid, w["shard_len"])
+            txn.setattr(self.coll, oid, SIZE_XATTR,
+                        str(w["size"]).encode())
+            txn.setattr(self.coll, oid, VER_XATTR,
+                        ver_encode(entry.version))
         elif w.get("touch"):
             # create-only / attr-only: never rewrite shard content
             txn.touch(self.coll, oid)

@@ -96,6 +96,14 @@ class StripeInfo:
                     else np.zeros(0, np.uint8))
                 for i, bufs in shards.items()}
 
+    @staticmethod
+    def data_positions(codec) -> list[int]:
+        """Shard ids hosting data chunks 0..k-1 (mapped codes like lrc
+        place data at chunk_index(i), not i)."""
+        k = codec.get_data_chunk_count()
+        idx = getattr(codec, "chunk_index", None)
+        return [idx(i) if idx else i for i in range(k)]
+
     def decode(self, codec, shard_bufs: Mapping[int, np.ndarray],
                want: set[int] | None = None) -> dict[int, np.ndarray]:
         """Reconstruct shard buffers (possibly all) from available shards.
@@ -104,7 +112,8 @@ class StripeInfo:
         per-stripe through the plugin and reconcatenates.
         """
         self._check_codec(codec)
-        want = set(range(self.k)) if want is None else set(want)
+        want = (set(self.data_positions(codec)) if want is None
+                else set(want))
         lens = {len(b) for b in shard_bufs.values()}
         assert len(lens) == 1, lens
         shard_len = lens.pop()
@@ -125,13 +134,13 @@ class StripeInfo:
     def reconstruct_logical(self, codec,
                             shard_bufs: Mapping[int, np.ndarray]) -> bytes:
         """Rebuild the logical byte stream from shard buffers."""
-        data_shards = self.decode(codec, shard_bufs,
-                                  want=set(range(self.k)))
+        dpos = self.data_positions(codec)
+        data_shards = self.decode(codec, shard_bufs, want=set(dpos))
         shard_len = len(next(iter(data_shards.values())))
         n_stripes = shard_len // self.chunk_size
         parts = []
         for s in range(n_stripes):
             lo, hi = s * self.chunk_size, (s + 1) * self.chunk_size
-            for i in range(self.k):
-                parts.append(np.asarray(data_shards[i][lo:hi]))
+            for p in dpos:
+                parts.append(np.asarray(data_shards[p][lo:hi]))
         return b"".join(p.tobytes() for p in parts)

@@ -510,7 +510,12 @@ class OSD:
         if pg is not None:
             entry = LogEntry.from_dict(msg.data["entry"])
             w = msg.data["w"]
-            n_data_segs = 0 if (w.get("remove") or w.get("touch")) else 1
+            if w.get("writes") is not None:      # ranged RMW sub-write
+                n_data_segs = len(w["writes"])
+            elif w.get("remove") or w.get("touch"):
+                n_data_segs = 0
+            else:
+                n_data_segs = 1
             attr_muts = unpack_mutations(msg.data.get("attr_muts", []),
                                          msg.segments[n_data_segs:])
             pg.backend.apply_sub_write(
@@ -528,8 +533,10 @@ class OSD:
         data, buf, size = {"tid": msg.data.get("tid")}, b"", 0
         if pg is not None:
             oid = msg.data["oid"]
+            off = int(msg.data.get("off", 0))
+            length = msg.data.get("len")     # None = whole shard
             try:
-                buf = self.store.read(pg.coll, oid, 0, None)
+                buf = self.store.read(pg.coll, oid, off, length)
             except FileNotFoundError:
                 buf = b""
             from .backend import SIZE_XATTR, VER_XATTR, ver_decode

@@ -154,6 +154,7 @@ class PG:
         self.acting = list(acting)
         self.info.same_interval_since = epoch
         self.state = "peering" if self.is_primary() else "stray"
+        self.backend.invalidate_extents()   # interval change: stale cache
         if self._recovery_task:
             self._recovery_task.cancel()
             self._recovery_task = None
@@ -791,6 +792,7 @@ class PG:
 
     def _apply_recovery_payload(self, oid: str, data: dict,
                                 segments: list[bytes]) -> None:
+        self.backend.invalidate_extents(oid)
         txn = Transaction()
         if data.get("absent"):
             txn.remove(self.coll, oid)

@@ -186,6 +186,7 @@ class Messenger:
         self._sessions: dict[str, int] = {}
         self._session_inst: dict[str, str] = {}      # peer -> incarnation
         self._connect_locks: dict[str, asyncio.Lock] = {}
+        self._shutting_down = False
         self._server: asyncio.base_events.Server | None = None
         self.addr: tuple[str, int] | None = None
         self._accept_tasks: set[asyncio.Task] = set()
@@ -200,10 +201,16 @@ class Messenger:
         self.dispatchers.append(fn)
 
     async def _on_accept(self, reader, writer) -> None:
+        if self._shutting_down:
+            writer.close()
+            return
         try:
             peer_name, inst = await self._handshake_server_read(
                 reader, writer)
         except (asyncio.IncompleteReadError, ValueError, ConnectionError):
+            writer.close()
+            return
+        if self._shutting_down:      # raced shutdown during handshake
             writer.close()
             return
         # close any stale conn from this peer BEFORE touching session
@@ -410,6 +417,14 @@ class Messenger:
                 pass
 
     async def shutdown(self) -> None:
+        # stop accepting BEFORE closing connections: closing a conn
+        # triggers the peer's instant reconnect, and a still-open
+        # listener would accept it -- a ghost connection that survives
+        # shutdown and keeps this daemon answering (e.g. heartbeats
+        # from a "dead" OSD, defeating failure detection)
+        self._shutting_down = True
+        if self._server is not None:
+            self._server.close()
         for t in list(self._accept_tasks):
             t.cancel()
         for conn in (list(self.conns.values())
@@ -418,7 +433,6 @@ class Messenger:
         self.conns.clear()
         self.conns_in.clear()
         if self._server is not None:
-            self._server.close()
             # 3.12 wait_closed blocks until every peer transport is
             # gone; peers shutting down concurrently make that a
             # deadlock, so bound it

@@ -57,6 +57,15 @@ class ObjectStore:
     def list_objects(self, coll: str) -> list[str]:
         raise NotImplementedError
 
+    def list_objects_range(self, coll: str, begin: str,
+                           limit: int) -> list[str]:
+        """Up to ``limit`` object names > ``begin`` in name order.
+
+        Backends override with an indexed scan; the fallback sorts the
+        full listing (correct, O(N log N) per page)."""
+        names = sorted(o for o in self.list_objects(coll) if o > begin)
+        return names[:limit]
+
     def collection_exists(self, coll: str) -> bool:
         return coll in self.list_collections()
 
@@ -180,6 +189,11 @@ class MemStore(ObjectStore):
 
     def list_objects(self, coll):
         return sorted(self._colls.get(coll, {}))
+
+    def list_objects_range(self, coll, begin, limit):
+        import heapq
+        return heapq.nsmallest(
+            limit, (o for o in self._colls.get(coll, {}) if o > begin))
 
 
 class DBStore(ObjectStore):
@@ -369,3 +383,8 @@ class DBStore(ObjectStore):
     def list_objects(self, coll):
         return [r[0] for r in self._conn().execute(
             "SELECT oid FROM objects WHERE coll=? ORDER BY oid", (coll,))]
+
+    def list_objects_range(self, coll, begin, limit):
+        return [r[0] for r in self._conn().execute(
+            "SELECT oid FROM objects WHERE coll=? AND oid>? "
+            "ORDER BY oid LIMIT ?", (coll, begin, limit))]

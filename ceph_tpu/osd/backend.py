@@ -17,7 +17,7 @@ import numpy as np
 
 from ..os.transaction import Transaction
 from .ec_util import StripeInfo
-from .types import LogEntry
+from .types import LogEntry, MissingSet, ZERO
 
 META_OID = "_pgmeta_"
 SIZE_XATTR = "_size"
@@ -149,6 +149,29 @@ class PGBackend:
         backfill, peering reset): drop any cached extents.  No-op for
         backends without a cache."""
 
+    async def _fanout_commits(self, awaiting, entry: LogEntry) -> None:
+        """All-commit fan-out with laggard healing.
+
+        A peer that fails to ack inside the timeout has NOT applied the
+        write but stays acting (nobody died, no re-peer).  Leaving it be
+        is a time bomb: the object's data there is stale, and a later
+        write that only stamps versions (the ranged RMW path) would make
+        the staleness invisible.  The reference wedges the op until the
+        laggard commits or is marked down (all_commit); this framework
+        heals forward instead -- the laggard is recorded missing that
+        object and recovery re-pushes the full object."""
+        if not awaiting:
+            return
+        replies = await self.osd.fanout_and_wait(awaiting, collect=True)
+        acked = {r.data.get("from_osd") for r in replies}
+        laggards = [t[0] for t in awaiting if t[0] not in acked]
+        if not laggards:
+            return
+        for osd_id in laggards:
+            ms = self.pg.peer_missing.setdefault(osd_id, MissingSet())
+            ms.add(entry.oid, need=entry.version, have=ZERO)
+        self.pg.kick_recovery()
+
 
 def build_pg_backend(pg):
     """PGBackend.cc:570 — pool type picks the backend."""
@@ -167,21 +190,37 @@ class ReplicatedBackend(PGBackend):
         self.pg.append_log_and_meta(txn, entry)
         self.store.queue_transaction(txn)
         # fan out to every other acting replica and wait for all commits
-        # (ReplicatedBackend.cc: all_commit before client reply)
+        # (ReplicatedBackend.cc: all_commit before client reply).
+        # Backfill targets beyond their last_backfill watermark get the
+        # LOG ENTRY only (empty transaction): their data for that object
+        # arrives when the backfill scan reaches it, but their log/
+        # last_update must stay in step with the acting set.
         meta, segs = pack_mutations(muts)
-        payload = {"pgid": self.pg.pgid, "entry": entry.to_dict(),
-                   "muts": meta}
-        await self.osd.fanout_and_wait(
-            [(o, "rep_op", payload, segs) for o in self.pg.acting
-             if o >= 0 and o != self.osd.whoami])
+        targets = []
+        for o in self.pg.acting:
+            if o < 0 or o == self.osd.whoami:
+                continue
+            if self.pg.should_send_to(o, entry.oid):
+                targets.append((o, "rep_op",
+                                {"pgid": self.pg.pgid,
+                                 "entry": entry.to_dict(),
+                                 "muts": meta}, segs))
+            else:
+                targets.append((o, "rep_op",
+                                {"pgid": self.pg.pgid,
+                                 "entry": entry.to_dict(),
+                                 "muts": [], "log_only": True}, []))
+        await self._fanout_commits(targets, entry)
 
-    def apply_rep_op(self, entry: LogEntry, muts: list[dict]) -> None:
+    def apply_rep_op(self, entry: LogEntry, muts: list[dict],
+                     log_only: bool = False) -> None:
         """Replica side: apply the primary's resolved mutations."""
         txn = Transaction()
-        apply_mutations(txn, self.coll, entry.oid, muts)
-        if not entry.is_delete():
-            txn.setattr(self.coll, entry.oid, VER_XATTR,
-                        ver_encode(entry.version))
+        if not log_only:
+            apply_mutations(txn, self.coll, entry.oid, muts)
+            if not entry.is_delete():
+                txn.setattr(self.coll, entry.oid, VER_XATTR,
+                            ver_encode(entry.version))
         self.pg.append_log_and_meta(txn, entry)
         self.store.queue_transaction(txn)
 
@@ -225,6 +264,14 @@ class ECBackend(PGBackend):
         self.sinfo = StripeInfo.for_codec(
             self.codec, stripe_unit=int(profile.get("stripe_unit", 4096)))
         self.cache = ExtentCache()
+
+    def _log_only_subop(self, osd: int, shard: int, entry: LogEntry):
+        """ec_subop_write carrying only the log entry (backfill target
+        beyond its watermark)."""
+        return (osd, "ec_subop_write",
+                {"pgid": self.pg.pgid, "oid": entry.oid, "shard": shard,
+                 "entry": entry.to_dict(), "w": {"log_only": True},
+                 "attr_muts": []}, [])
 
     @property
     def k(self) -> int:
@@ -366,6 +413,9 @@ class ECBackend(PGBackend):
                 if osd == self.osd.whoami:
                     self.apply_sub_write(entry, {"touch": True}, [],
                                          attr_muts)
+                elif not self.pg.should_send_to(osd, entry.oid):
+                    awaiting.append(
+                        self._log_only_subop(osd, shard, entry))
                 else:
                     payload = {"pgid": self.pg.pgid, "oid": entry.oid,
                                "shard": shard, "entry": entry.to_dict(),
@@ -374,7 +424,7 @@ class ECBackend(PGBackend):
                     awaiting.append((osd, "ec_subop_write", payload,
                                      attr_segs))
             if awaiting:
-                await self.osd.fanout_and_wait(awaiting)
+                await self._fanout_commits(awaiting, entry)
             return
         old_size = await self.object_size(entry.oid)
         plan = self._plan_rmw(content_muts, old_size)
@@ -439,18 +489,21 @@ class ECBackend(PGBackend):
         for shard, osd in enumerate(acting):
             if osd < 0:
                 continue
-            payload = {"pgid": self.pg.pgid, "oid": entry.oid,
-                       "shard": shard, "entry": entry.to_dict(),
-                       "w": per_shard[shard],
-                       "attr_muts": pack_mutations(attr_muts)[0]}
-            segs = segs_per_shard[shard] + pack_mutations(attr_muts)[1]
             if osd == self.osd.whoami:
-                self.apply_sub_write(entry, payload["w"],
+                self.apply_sub_write(entry, per_shard[shard],
                                      segs_per_shard[shard], attr_muts)
+            elif not self.pg.should_send_to(osd, entry.oid):
+                awaiting.append(self._log_only_subop(osd, shard, entry))
             else:
+                payload = {"pgid": self.pg.pgid, "oid": entry.oid,
+                           "shard": shard, "entry": entry.to_dict(),
+                           "w": per_shard[shard],
+                           "attr_muts": pack_mutations(attr_muts)[0]}
+                segs = (segs_per_shard[shard]
+                        + pack_mutations(attr_muts)[1])
                 awaiting.append((osd, "ec_subop_write", payload, segs))
         if awaiting:
-            await self.osd.fanout_and_wait(awaiting)
+            await self._fanout_commits(awaiting, entry)
 
     # -- partial-stripe RMW pipeline ----------------------------------------
     # The reference's RMWPipeline (ECCommon.cc:704 start_rmw ->
@@ -594,6 +647,8 @@ class ECBackend(PGBackend):
             segs = [buf for _, buf in shard_writes[shard]]
             if osd == self.osd.whoami:
                 self.apply_sub_write(entry, w, segs, attr_muts)
+            elif not self.pg.should_send_to(osd, oid):
+                awaiting.append(self._log_only_subop(osd, shard, entry))
             else:
                 payload = {"pgid": self.pg.pgid, "oid": oid,
                            "shard": shard, "entry": entry.to_dict(),
@@ -601,12 +656,17 @@ class ECBackend(PGBackend):
                 awaiting.append((osd, "ec_subop_write", payload,
                                  segs + attr_segs))
         if awaiting:
-            await self.osd.fanout_and_wait(awaiting)
+            await self._fanout_commits(awaiting, entry)
 
     def apply_sub_write(self, entry: LogEntry, w: dict,
                         segs: list[bytes], attr_muts: list[dict]) -> None:
         txn = Transaction()
         oid = entry.oid
+        if w.get("log_only"):
+            # backfill target beyond its watermark: log entry only
+            self.pg.append_log_and_meta(txn, entry)
+            self.store.queue_transaction(txn)
+            return
         if w.get("remove"):
             txn.remove(self.coll, oid)
         elif w.get("writes") is not None:

@@ -494,7 +494,9 @@ class OSD:
         if pg is not None:
             entry = LogEntry.from_dict(msg.data["entry"])
             muts = unpack_mutations(msg.data["muts"], msg.segments)
-            pg.backend.apply_rep_op(entry, muts)
+            pg.backend.apply_rep_op(entry, muts,
+                                    log_only=bool(
+                                        msg.data.get("log_only")))
             self.perf_osd.inc("subop_w")
         await conn.send(Message("rep_op_reply",
                                 {"tid": msg.data.get("tid"),
@@ -512,7 +514,7 @@ class OSD:
             w = msg.data["w"]
             if w.get("writes") is not None:      # ranged RMW sub-write
                 n_data_segs = len(w["writes"])
-            elif w.get("remove") or w.get("touch"):
+            elif w.get("remove") or w.get("touch") or w.get("log_only"):
                 n_data_segs = 0
             else:
                 n_data_segs = 1
@@ -620,9 +622,24 @@ class OSD:
         if pg is None:
             data["err"] = "ENXIO"
         else:
-            data["objects"] = {o: list(v)
-                               for o, v in pg.object_vers().items()}
+            objs, exhausted = pg.scan_range(
+                msg.data.get("begin", ""),
+                int(msg.data.get("limit", 0)) or 10 ** 9)
+            data["objects"] = {o: list(v) for o, v in objs.items()}
+            data["exhausted"] = exhausted
         await conn.send(Message("pg_scan_reply", data))
+
+    async def _h_pg_backfill_progress(self, conn, msg) -> None:
+        pg = self._get_pg(msg.data["pgid"])
+        if pg is None:
+            data = {"err": "ENXIO", "from_osd": self.whoami}
+        else:
+            data = pg.on_backfill_progress(msg.data["cursor"])
+        data["tid"] = msg.data.get("tid")
+        await conn.send(Message("pg_backfill_progress_reply", data))
+
+    async def _h_pg_backfill_progress_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
 
     async def _h_pg_scan_reply(self, conn, msg) -> None:
         self._resolve_tid(msg)

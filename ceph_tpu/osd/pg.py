@@ -26,6 +26,7 @@ from .types import (
 )
 
 LOG_CAP = 512           # entries kept in the in-memory/persisted log
+SCAN_BATCH = 128        # objects per pg_scan page / backfill batch
 
 # client op names that mutate
 WRITE_OPS = {"create", "write", "writefull", "append", "truncate", "zero",
@@ -48,6 +49,13 @@ class PG:
         self.peer_log_entries: dict[int, list[LogEntry]] = {}
         self.peer_missing: dict[int, MissingSet] = {}
         self.backfill_targets: set[int] = set()
+        # per-target incremental backfill state (primary side):
+        # cursor  -- the peer's confirmed last_backfill watermark
+        # inflight -- oid -> Event while a push is in progress (client
+        #             writes to that oid wait instead of racing it)
+        # pushed  -- oids pushed in the current batch (> cursor): client
+        #            writes to these DO go to the peer
+        self.backfill_info: dict[int, dict] = {}
         self.past_intervals = PastIntervals()
         self.up: list[int] = []
         self.acting: list[int] = []
@@ -249,16 +257,23 @@ class PG:
         # GetMissing: what does each acting peer need?
         auth_log = self.log
         self.backfill_targets.clear()
+        self.backfill_info.clear()
         for osd_id in self.acting_peers():
             pinfo = self.peer_info.get(osd_id)
             if pinfo is None:
                 continue
             if (pinfo.last_update < auth_log.tail
                     or not pinfo.backfill_complete):
-                # peer's log cannot bridge: whole-PG scan diff
+                # peer's log cannot bridge: incremental cursor-driven
+                # backfill, resuming from the peer's PERSISTED
+                # last_backfill (a fresh gap resets it via activate)
                 self.backfill_targets.add(osd_id)
-                self.peer_missing[osd_id] = await self._scan_diff_for_peer(
-                    osd_id)
+                cursor = (pinfo.last_backfill
+                          if not pinfo.backfill_complete else "")
+                self.backfill_info[osd_id] = {
+                    "cursor": cursor, "inflight": {}, "pushed": set(),
+                    "dirty": set(), "done": False}
+                self.peer_missing[osd_id] = MissingSet()
             else:
                 self.peer_missing[osd_id] = PGLog.proc_replica_log(
                     pinfo, self.peer_log_entries.get(osd_id, []), auth_log)
@@ -292,7 +307,8 @@ class PG:
                 f"pg {self.pgid}: no activate ack from up peers {unacked}")
         self.state = "active"
         self.persist_meta()
-        if self.missing or any(self.peer_missing.values()):
+        if (self.missing or any(self.peer_missing.values())
+                or self.backfill_targets):
             self.kick_recovery()
 
     def object_vers(self) -> dict[str, tuple[int, int]]:
@@ -306,29 +322,46 @@ class PG:
                 self.osd.store.getattr(self.coll, oid, VER_XATTR))
         return out
 
-    async def _fetch_scan(self, osd_id: int) -> dict[str, tuple[int, int]]:
+    def scan_range(self, begin: str,
+                   limit: int) -> tuple[dict[str, tuple[int, int]], bool]:
+        """Bounded scan: up to ``limit`` objects with name > begin, in
+        name order, plus an exhausted flag.  Keeps pg_scan messages and
+        backfill working sets O(limit) instead of O(PG)."""
+        from .backend import VER_XATTR, ver_decode
+        # +1 as the exhaustion probe; META_OID may occupy one slot
+        names = [o for o in self.osd.store.list_objects_range(
+            self.coll, begin, limit + 2) if o != META_OID]
+        batch = names[:limit]
+        out = {oid: ver_decode(
+            self.osd.store.getattr(self.coll, oid, VER_XATTR))
+            for oid in batch}
+        return out, len(names) <= limit
+
+    async def _fetch_scan_page(
+            self, osd_id: int, begin: str,
+            limit: int) -> tuple[dict[str, tuple[int, int]], bool]:
+        """One bounded scan page from a peer: ({oid: ver}, exhausted)."""
         replies = await self.osd.fanout_and_wait(
-            [(osd_id, "pg_scan", {"pgid": self.pgid}, [])],
+            [(osd_id, "pg_scan",
+              {"pgid": self.pgid, "begin": begin, "limit": limit}, [])],
             collect=True, timeout=10)
         if not replies or replies[0].data.get("err"):
             raise asyncio.TimeoutError(f"pg_scan osd.{osd_id} failed")
-        return {o: tuple(v)
+        objs = {o: tuple(v)
                 for o, v in replies[0].data["objects"].items()}
+        return objs, bool(replies[0].data.get("exhausted", True))
 
-    async def _scan_diff_for_peer(self, osd_id: int) -> MissingSet:
-        """Whole-PG backfill diff: every object whose stored version
-        differs from ours must be pushed; objects only the peer has are
-        pushed as absent (= removed there)."""
-        peer_objs = await self._fetch_scan(osd_id)
-        ms = MissingSet()
-        local = self.object_vers()
-        for oid, ver in local.items():
-            if peer_objs.get(oid) != ver:
-                ms.add(oid, need=EVersion(*ver), have=ZERO)
-        for oid in peer_objs:
-            if oid not in local:
-                ms.add(oid, need=self.info.last_update, have=ZERO)
-        return ms
+    async def _fetch_scan(self, osd_id: int) -> dict[str, tuple[int, int]]:
+        """Full peer scan, paged so every message stays O(SCAN_BATCH)."""
+        out: dict[str, tuple[int, int]] = {}
+        cursor = ""
+        while True:
+            objs, exhausted = await self._fetch_scan_page(
+                osd_id, cursor, SCAN_BATCH)
+            out.update(objs)
+            if exhausted or not objs:
+                return out
+            cursor = max(objs)
 
     async def _backfill_self(self, auth_osd: int) -> None:
         """The PRIMARY's own data is gapped: pull-diff against the auth
@@ -360,7 +393,12 @@ class PG:
                             for e in msg.data["entries"]]
             if not self.log.overlaps(auth_info):
                 # adopting the log wholesale across a trim gap: data is
-                # NOT caught up until the primary's backfill finishes
+                # NOT caught up until the primary's backfill finishes.
+                # The gap also invalidates any existing backfill cursor:
+                # writes to objects below it may hide in the lost log
+                # window, so the scan must restart (an overlapping log
+                # keeps the cursor -- that is the resume case).
+                self.info.last_backfill = ""
                 self.info.backfill_complete = False
             divergent = self.log.merge(auth_entries, auth_info,
                                        self.missing)
@@ -375,10 +413,20 @@ class PG:
             return {"pgid": self.pgid, "missing": self.missing.to_dict(),
                     "from_osd": self.whoami}
 
+    def on_backfill_progress(self, cursor: str) -> dict:
+        """The primary's backfill scan passed ``cursor``: persist it so
+        an interrupted backfill resumes here instead of from scratch
+        (PeeringState.h:1928 last_backfill update)."""
+        if cursor > self.info.last_backfill:
+            self.info.last_backfill = cursor
+            self.persist_meta()
+        return {"pgid": self.pgid, "from_osd": self.whoami}
+
     def on_backfill_done(self) -> dict:
-        """Primary finished pushing the scan diff: our data now matches
+        """Primary finished the backfill scan: our data now matches
         our (wholesale-adopted) log."""
         self.info.backfill_complete = True
+        self.info.last_backfill = ""
         if not self.missing:
             self.info.last_complete = self.info.last_update
         self.persist_meta()
@@ -424,7 +472,8 @@ class PG:
             if self.missing.is_missing(oid):
                 await self._recover_object(oid)
             for peer, ms in self.peer_missing.items():
-                if ms.is_missing(oid) and self.osd.osd_is_up(peer):
+                if ms.is_missing(oid) and self.osd.osd_is_up(peer) \
+                        and self.should_send_to(peer, oid):
                     await self._push_object(peer, oid)
             # ops execute strictly in vector order (the reference runs
             # the vector through one ObjectContext): reads that follow
@@ -605,6 +654,7 @@ class PG:
                          reqid: tuple[str, int] | None = None) -> str | None:
         """Resolve logical ops to offset-explicit mutations, append a log
         entry, run the backend transaction."""
+        await self.wait_for_backfill_pushes(oid)
         size = await self.backend.object_size(oid)
         muts: list[dict] = []
         is_delete = False       # tracks the FINAL state: remove followed
@@ -679,7 +729,8 @@ class PG:
     def _recovery_pending(self) -> bool:
         return bool(self.missing) or any(
             ms and self.osd.osd_is_up(peer)
-            for peer, ms in self.peer_missing.items())
+            for peer, ms in self.peer_missing.items()) or any(
+            self.osd.osd_is_up(p) for p in self.backfill_targets)
 
     async def _recovery_loop(self) -> None:
         """Recover until clean; transient peer failures (reboots, races)
@@ -701,6 +752,7 @@ class PG:
                         if not self.missing:
                             if not self.info.backfill_complete:
                                 self.info.backfill_complete = True
+                                self.info.last_backfill = ""
                             self.info.last_complete = self.info.last_update
                         for peer, ms in list(self.peer_missing.items()):
                             if (not self.osd.osd_is_up(peer)
@@ -708,7 +760,13 @@ class PG:
                                 continue
                             for oid in list(ms.items):
                                 await self._push_object(peer, oid)
-                        await self._do_backfills()
+                    # backfill runs OUTSIDE the PG lock (it takes it
+                    # per scan batch / payload read): client I/O to the
+                    # PG proceeds between pushes instead of stalling for
+                    # the whole round (PrimaryLogPG interleaves recovery
+                    # with ops the same way, per-object blocking only)
+                    await self._do_backfills()
+                    async with self.lock:
                         self.persist_meta()
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     pass
@@ -717,14 +775,159 @@ class PG:
         except asyncio.CancelledError:
             pass
 
+    # -- incremental, cursor-driven backfill --------------------------------
+    def should_send_to(self, peer: int, oid: str) -> bool:
+        """Does a client write to ``oid`` go to ``peer``?
+
+        Backfill targets only receive writes for objects the backfill
+        has already covered (oid <= cursor, or pushed in the current
+        batch); anything beyond the watermark is picked up when the
+        scan reaches it (PrimaryLogPG's should_send_op / last_backfill
+        check).  Non-targets always receive writes.
+
+        SIDE EFFECT: a skip is recorded in the target's dirty set --
+        the object may sit inside the batch window the scan already
+        snapshotted (equal versions then, changed now), so the batch
+        re-pushes dirty objects before advancing the cursor past them.
+        """
+        if peer not in self.backfill_targets:
+            return True
+        bi = self.backfill_info.get(peer)
+        if bi is None:
+            return False
+        if bi["done"] or oid <= bi["cursor"] or oid in bi["pushed"]:
+            return True
+        bi["dirty"].add(oid)
+        return False
+
+    async def wait_for_backfill_pushes(self, oid: str) -> None:
+        """Client writes to an object with an in-flight backfill push
+        wait for the push: otherwise the pushed (old) content could land
+        after the write's fan-out and resurrect stale bytes."""
+        while True:
+            evs = [bi["inflight"][oid]
+                   for bi in self.backfill_info.values()
+                   if oid in bi["inflight"]]
+            if not evs:
+                return
+            for ev in evs:
+                await ev.wait()
+
+    async def _backfill_push(self, peer: int, oid: str) -> bool:
+        """Push one object (or its absence) to a backfill target with
+        the per-object interlock.  Returns True on ack."""
+        bi = self.backfill_info[peer]
+        ev = asyncio.Event()
+        try:
+            # the lock is held ONLY to mark the interlock: no write is
+            # mid-submit when the mark lands (writers hold the lock for
+            # their whole submit), and later writers wait on the event.
+            # The payload read itself -- a remote shard fanout for EC
+            # pools -- runs without the lock so client I/O proceeds.
+            async with self.lock:
+                bi["inflight"][oid] = ev
+            payload = await self.backend.read_recovery_payload(
+                oid, self._shard_of(peer))
+            replies = await self.osd.fanout_and_wait(
+                [(peer, "pg_push",
+                  {"pgid": self.pgid, "oid": oid,
+                   "absent": payload.get("absent", False),
+                   "xattrs": {k: v.hex()
+                              for k, v in payload["xattrs"].items()},
+                   "omap": {k: v.hex()
+                            for k, v in payload["omap"].items()}},
+                  [payload["data"]])], collect=True, timeout=10)
+            if not replies or replies[0].data.get("err"):
+                return False
+            bi["pushed"].add(oid)
+            ms = self.peer_missing.get(peer)
+            if ms is not None:
+                ms.items.pop(oid, None)
+            return True
+        finally:
+            bi["inflight"].pop(oid, None)
+            ev.set()
+
+    async def _backfill_one(self, peer: int) -> None:
+        """Advance one peer's backfill to completion in SCAN_BATCH
+        batches.  The PG lock is held only for the local scan and each
+        payload read -- client I/O proceeds between pushes."""
+        bi = self.backfill_info[peer]
+        while not bi["done"]:
+            if not self.osd.osd_is_up(peer):
+                raise asyncio.TimeoutError(f"osd.{peer} down mid-backfill")
+            async with self.lock:
+                local, local_done = self.scan_range(bi["cursor"],
+                                                    SCAN_BATCH)
+            remote, remote_done = await self._fetch_scan_page(
+                peer, bi["cursor"], SCAN_BATCH)
+            # compare only below the lowest exhausted bound; names above
+            # it belong to the next batch
+            bounds = ([] if local_done else [max(local)]) + \
+                     ([] if remote_done else [max(remote)])
+            bound = min(bounds) if bounds else None
+            work_l = {o: v for o, v in local.items()
+                      if bound is None or o <= bound}
+            work_r = {o: v for o, v in remote.items()
+                      if bound is None or o <= bound}
+            todo = [o for o, v in work_l.items() if work_r.get(o) != v]
+            todo += [o for o in work_r if o not in work_l]
+            for oid in sorted(todo):
+                if not await self._backfill_push(peer, oid):
+                    raise asyncio.TimeoutError(
+                        f"backfill push {oid} to osd.{peer} failed")
+            new_cursor = bound if bound is not None else (
+                max(list(work_l) + list(work_r) + [bi["cursor"]]))
+            # drain writes that were skipped (log_only) while this batch
+            # was in flight: their objects sit inside the window the
+            # scan snapshotted, so the diff above missed them.  Repeat
+            # until quiet -- pushes can race yet more writes in.
+            while True:
+                # the FINAL batch (bound None) drains everything: a
+                # brand-new object past the last scanned name has no
+                # later batch to catch it
+                redo = sorted(o for o in bi["dirty"]
+                              if bound is None or o <= new_cursor)
+                if not redo:
+                    break
+                for oid in redo:
+                    if not await self._backfill_push(peer, oid):
+                        raise asyncio.TimeoutError(
+                            f"backfill dirty push {oid} to osd.{peer} "
+                            f"failed")
+                    bi["dirty"].discard(oid)
+            # no await between the quiet check and the cursor advance:
+            # nothing can slip in below new_cursor
+            bi["cursor"] = new_cursor
+            bi["pushed"] = {o for o in bi["pushed"] if o > new_cursor}
+            # dirty oids above the cursor are re-scanned by later
+            # batches (their writes committed before those scans run)
+            bi["dirty"] = {o for o in bi["dirty"] if o > new_cursor}
+            if bound is None:
+                bi["done"] = True
+            replies = await self.osd.fanout_and_wait(
+                [(peer, "pg_backfill_progress",
+                  {"pgid": self.pgid, "cursor": new_cursor}, [])],
+                collect=True, timeout=10)
+            if not replies or replies[0].data.get("err"):
+                raise asyncio.TimeoutError(
+                    f"backfill progress to osd.{peer} failed")
+        replies = await self.osd.fanout_and_wait(
+            [(peer, "pg_backfill_done", {"pgid": self.pgid}, [])],
+            collect=True, timeout=10)
+        if replies and not replies[0].data.get("err"):
+            self.backfill_targets.discard(peer)
+            pinfo = self.peer_info.get(peer)
+            if pinfo is not None:
+                pinfo.backfill_complete = True
+
     async def _do_backfills(self) -> None:
-        """Push the scan diff to each backfill target under reservation
-        slots, then tell it backfill is complete."""
+        """Advance every backfill target under reservation slots
+        (AsyncReserver.h / osd_max_backfills)."""
         for peer in list(self.backfill_targets):
             if not self.osd.osd_is_up(peer):
                 continue
-            ms = self.peer_missing.get(peer)
-            if ms is None:
+            if peer not in self.backfill_info:
                 continue
             token = (self.pgid, peer)
             granted_remote = False
@@ -736,20 +939,9 @@ class PG:
                 if not replies or not replies[0].data.get("granted"):
                     continue            # remote slot busy; next round
                 granted_remote = True
-                for oid in list(ms.items):
-                    await self._push_object(peer, oid)
-                if not ms:
-                    replies = await self.osd.fanout_and_wait(
-                        [(peer, "pg_backfill_done",
-                          {"pgid": self.pgid}, [])],
-                        collect=True, timeout=10)
-                    if replies and not replies[0].data.get("err"):
-                        self.backfill_targets.discard(peer)
-                        pinfo = self.peer_info.get(peer)
-                        if pinfo is not None:
-                            pinfo.backfill_complete = True
+                await self._backfill_one(peer)
             except asyncio.TimeoutError:
-                continue                # slot contention; retry next round
+                continue                # retried next recovery round
             finally:
                 self.osd.local_reserver.release(token)
                 if granted_remote:

@@ -94,6 +94,12 @@ class PGInfo:
     # what the data actually holds (pg_info_t::last_backfill analog --
     # True plays the role of last_backfill == MAX)
     backfill_complete: bool = True
+    # cursor while backfill_complete is False: every object with name
+    # <= last_backfill (lexicographic; the reference walks hobject hash
+    # order, PeeringState.h:1928) has been backfilled and receives
+    # normal write traffic; "" = nothing backfilled yet.  Persisted so
+    # an interrupted backfill RESUMES instead of restarting.
+    last_backfill: str = ""
 
     def is_empty(self) -> bool:
         return not self.last_update
@@ -105,7 +111,8 @@ class PGInfo:
                 "log_tail": self.log_tail.to_list(),
                 "last_epoch_started": self.last_epoch_started,
                 "same_interval_since": self.same_interval_since,
-                "backfill_complete": self.backfill_complete}
+                "backfill_complete": self.backfill_complete,
+                "last_backfill": self.last_backfill}
 
     @classmethod
     def from_dict(cls, d: dict) -> "PGInfo":
@@ -115,7 +122,8 @@ class PGInfo:
                    log_tail=EVersion.from_list(d["log_tail"]),
                    last_epoch_started=d.get("last_epoch_started", 0),
                    same_interval_since=d.get("same_interval_since", 0),
-                   backfill_complete=d.get("backfill_complete", True))
+                   backfill_complete=d.get("backfill_complete", True),
+                   last_backfill=d.get("last_backfill", ""))
 
 
 class MissingSet:

@@ -153,3 +153,214 @@ def test_thrasher_no_lost_writes():
         finally:
             await c.stop()
     run(main())
+
+
+def test_backfill_resumes_from_cursor_after_primary_kill():
+    """Interrupted backfill must RESUME from the target's persisted
+    last_backfill, not restart (PeeringState.h:1928,2003)."""
+    import ceph_tpu.osd.pg as pgmod
+
+    async def main():
+        old_batch = pgmod.SCAN_BATCH
+        pgmod.SCAN_BATCH = 16       # many batches -> catch it mid-flight
+        c = await make_cluster(3, osd_config={
+            "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 2.0})
+        try:
+            await c.command("osd pool create",
+                            {"name": "rbd", "pg_num": 1, "size": 3,
+                             "min_size": 2})
+            pgid, primary, up = c.target_for("rbd", "seed")
+            victim = next(o for o in c.osds
+                          if o.whoami in up and o.whoami != primary)
+            vid, vuuid, vstore = victim.whoami, victim.uuid, victim.store
+            await victim.stop()
+            await wait_for(lambda: not c.mon.osdmap.is_up(vid),
+                           msg="victim down")
+            # enough writes to trim the log past the victim's head
+            for i in range(LOG_CAP + 80):
+                await c.osd_op("rbd", f"obj-{i:05d}", [
+                    {"op": "write", "off": 0,
+                     "data": f"v{i}".encode() * 20}])
+            revived = OSD(uuid=vuuid, whoami=vid, store=vstore,
+                          host=f"host{vid}",
+                          config={"osd_heartbeat_interval": 0.2,
+                                  "osd_heartbeat_grace": 2.0})
+            await revived.start(c.mon.msgr.addr)
+            c.osds = [o for o in c.osds if o.whoami != vid] + [revived]
+
+            # wait until the backfill is visibly mid-flight on the target
+            def mid_backfill():
+                pg = revived.pgs.get(pgid)
+                return (pg is not None
+                        and not pg.info.backfill_complete
+                        and pg.info.last_backfill != "")
+            await wait_for(mid_backfill, timeout=60,
+                           msg="backfill mid-flight with cursor")
+            cursor_at_kill = revived.pgs[pgid].info.last_backfill
+
+            # kill the PRIMARY mid-backfill
+            posd = next(o for o in c.osds if o.whoami == primary)
+            puuid, pstore = posd.uuid, posd.store
+            await posd.stop()
+            c.osds = [o for o in c.osds if o.whoami != primary]
+            await wait_for(lambda: not c.mon.osdmap.is_up(primary),
+                           msg="primary down")
+            # cursor must never regress while the new primary resumes
+            seen = [revived.pgs[pgid].info.last_backfill]
+
+            def done():
+                pg = revived.pgs.get(pgid)
+                if pg is None:
+                    return False
+                if not pg.info.backfill_complete:
+                    seen.append(pg.info.last_backfill)
+                return pg.info.backfill_complete
+            await wait_for(done, timeout=90, msg="backfill completed "
+                           "under the new primary")
+            assert all(s >= cursor_at_kill for s in seen if s), \
+                (cursor_at_kill, seen)
+
+            # revive the old primary; cluster converges; data correct
+            rep = OSD(uuid=puuid, whoami=primary, store=pstore,
+                      host=f"host{primary}",
+                      config={"osd_heartbeat_interval": 0.2,
+                              "osd_heartbeat_grace": 2.0})
+            await rep.start(c.mon.msgr.addr)
+            c.osds.append(rep)
+            for i in (0, 77, LOG_CAP + 79):
+                reply = await c.osd_op("rbd", f"obj-{i:05d}", [
+                    {"op": "read", "off": 0, "len": None}])
+                r, data = read_result(reply)
+                assert r.get("ok") and data == f"v{i}".encode() * 20, i
+        finally:
+            pgmod.SCAN_BATCH = old_batch
+            await c.stop()
+    run(main())
+
+
+def test_client_writes_proceed_during_backfill():
+    """The PG lock is not held across backfill batches: client I/O on
+    the same PG completes while a backfill is still in flight."""
+    import ceph_tpu.osd.pg as pgmod
+
+    async def main():
+        old_batch = pgmod.SCAN_BATCH
+        pgmod.SCAN_BATCH = 8
+        c = await make_cluster(3, osd_config={
+            "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 2.0})
+        try:
+            await c.command("osd pool create",
+                            {"name": "rbd", "pg_num": 1, "size": 3,
+                             "min_size": 2})
+            pgid, primary, up = c.target_for("rbd", "seed")
+            victim = next(o for o in c.osds
+                          if o.whoami in up and o.whoami != primary)
+            vid, vuuid, vstore = victim.whoami, victim.uuid, victim.store
+            await victim.stop()
+            await wait_for(lambda: not c.mon.osdmap.is_up(vid),
+                           msg="victim down")
+            for i in range(LOG_CAP + 80):
+                await c.osd_op("rbd", f"obj-{i:05d}", [
+                    {"op": "write", "off": 0, "data": b"x" * 64}])
+            revived = OSD(uuid=vuuid, whoami=vid, store=vstore,
+                          host=f"host{vid}",
+                          config={"osd_heartbeat_interval": 0.2,
+                                  "osd_heartbeat_grace": 2.0})
+            await revived.start(c.mon.msgr.addr)
+            c.osds = [o for o in c.osds if o.whoami != vid] + [revived]
+
+            def mid_backfill():
+                pg = revived.pgs.get(pgid)
+                return (pg is not None and not pg.info.backfill_complete
+                        and pg.info.last_backfill != "")
+            await wait_for(mid_backfill, timeout=60, msg="mid backfill")
+            # writes (to objects at both ends of the keyspace) complete
+            # WHILE the backfill is still incomplete
+            await asyncio.wait_for(c.osd_op("rbd", "a-front", [
+                {"op": "write", "off": 0, "data": b"live"}]), 10)
+            await asyncio.wait_for(c.osd_op("rbd", "zz-tail", [
+                {"op": "write", "off": 0, "data": b"live"}]), 10)
+            still_backfilling = not revived.pgs[pgid].info.backfill_complete
+            assert still_backfilling, \
+                "backfill finished before the writes; test proves nothing"
+            await wait_for(
+                lambda: revived.pgs[pgid].info.backfill_complete,
+                timeout=90, msg="backfill done")
+            for oid in ("a-front", "zz-tail"):
+                reply = await c.osd_op("rbd", oid, [
+                    {"op": "read", "off": 0, "len": None}])
+                r, data = read_result(reply)
+                assert r.get("ok") and data == b"live", oid
+        finally:
+            pgmod.SCAN_BATCH = old_batch
+            await c.stop()
+    run(main())
+
+
+def test_ec_thrasher_no_lost_writes():
+    """EC-pool thrasher: shard OSDs die and revive mid-write-stream --
+    every acked write must read back byte-correct (the stale-shard
+    version-stamp + backfill path under churn)."""
+    async def main():
+        c = await make_cluster(4, osd_config={
+            "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 2.0})
+        try:
+            await c.command("osd erasure-code-profile set",
+                            {"name": "p21", "profile": {
+                                "plugin": "tpu", "k": "2", "m": "1",
+                                "technique": "reed_sol_van"}})
+            await c.command("osd pool create",
+                            {"name": "ec", "type": "erasure",
+                             "pg_num": 4,
+                             "erasure_code_profile": "p21"})
+            acked: dict[str, bytes] = {}
+            stop_flag = {"stop": False}
+
+            async def writer(wid: int):
+                i = 0
+                while not stop_flag["stop"]:
+                    oid = f"w{wid}-o{i % 15}"
+                    payload = f"w{wid}-gen{i}".encode() * 8
+                    try:
+                        await c.osd_op("ec", oid, [
+                            {"op": "writefull", "data": payload}],
+                            timeout=5, retries=60)
+                        acked[oid] = payload
+                    except TimeoutError:
+                        pass
+                    i += 1
+                    await asyncio.sleep(0.02)
+
+            writers = [asyncio.ensure_future(writer(w)) for w in range(2)]
+            for round_no in range(3):
+                victim = c.osds[round_no % len(c.osds)]
+                vid, vuuid, vstore = (victim.whoami, victim.uuid,
+                                      victim.store)
+                await victim.stop()
+                await wait_for(lambda: not c.mon.osdmap.is_up(vid),
+                               msg=f"osd.{vid} down (round {round_no})")
+                await asyncio.sleep(1.5)
+                revived = OSD(uuid=vuuid, whoami=vid, store=vstore,
+                              host=f"host{vid}",
+                              config={"osd_heartbeat_interval": 0.2,
+                                      "osd_heartbeat_grace": 2.0})
+                await revived.start(c.mon.msgr.addr)
+                c.osds = [o for o in c.osds if o.whoami != vid]
+                c.osds.append(revived)
+                await wait_for(lambda: c.mon.osdmap.is_up(vid),
+                               msg=f"osd.{vid} up (round {round_no})")
+                await asyncio.sleep(1.0)
+            stop_flag["stop"] = True
+            await asyncio.gather(*writers, return_exceptions=True)
+            await asyncio.sleep(2.0)
+            assert len(acked) > 10, "thrasher produced too few writes"
+            for oid, payload in acked.items():
+                reply = await c.osd_op("ec", oid, [
+                    {"op": "read", "off": 0, "len": None}],
+                    timeout=10, retries=60)
+                r, data = read_result(reply)
+                assert r.get("ok") and data == payload, \
+                    f"lost/corrupt acked EC write {oid}"
+        finally:
+            await c.stop()
+    run(main())

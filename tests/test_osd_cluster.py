@@ -489,3 +489,53 @@ def test_ec_remove_recreate_one_vector_and_reserved_xattrs():
         finally:
             await c.stop()
     run(main())
+
+
+def test_laggard_replica_healed_after_dropped_subop():
+    """A replica that silently drops a sub-write (no reply, stays up)
+    is recorded missing that object and recovery re-pushes it -- the
+    stale copy must not survive (all-commit laggard healing)."""
+    async def main():
+        c = await make_cluster(3, osd_config={
+            "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 5.0})
+        try:
+            await c.command("osd pool create",
+                            {"name": "rbd", "pg_num": 1, "size": 3,
+                             "min_size": 2})
+            await c.osd_op("rbd", "lag-obj", [
+                {"op": "writefull", "data": b"v1" * 50}])
+            pgid, primary, up = c.target_for("rbd", "lag-obj")
+            replica = next(o for o in c.osds
+                           if o.whoami in up and o.whoami != primary)
+            # drop exactly one rep_op on the replica: applied nowhere,
+            # no reply sent
+            orig = replica._h_rep_op
+            dropped = {"n": 0}
+
+            async def dropper(conn, msg):
+                if (msg.data.get("entry", {}).get("oid") == "lag-obj"
+                        and dropped["n"] == 0):
+                    dropped["n"] += 1
+                    return          # swallow: no apply, no reply
+                await orig(conn, msg)
+
+            replica._h_rep_op = dropper
+            await c.osd_op("rbd", "lag-obj", [
+                {"op": "writefull", "data": b"v2" * 50}],
+                timeout=20, retries=3)
+            assert dropped["n"] == 1
+            # recovery must re-push the object to the laggard
+            for _ in range(100):
+                try:
+                    got = replica.store.read(f"pg_{pgid}", "lag-obj",
+                                             0, None)
+                    if got == b"v2" * 50:
+                        break
+                except FileNotFoundError:
+                    pass
+                await asyncio.sleep(0.3)
+            got = replica.store.read(f"pg_{pgid}", "lag-obj", 0, None)
+            assert got == b"v2" * 50, "laggard still stale"
+        finally:
+            await c.stop()
+    run(main())

@@ -1,0 +1,5 @@
+"""Manager-plane modules (the mgr module host analog,
+src/pybind/mgr): cluster-wide optimization passes that consume the
+OSDMap and emit map mutations.  The balancer is the flagship customer
+of the vectorized CRUSH op -- full-cluster placement recompute in one
+launch."""

@@ -340,6 +340,21 @@ class Monitor:
         self.failure_reports.pop(osd, None)
 
     # -- subscriptions ------------------------------------------------------
+    async def _h_osd_pg_temp(self, conn, msg) -> None:
+        """An OSD requests an acting-set override for a pg (MOSDPGTemp:
+        the gapped CRUSH primary hands serving to a complete peer while
+        it backfills; an empty list clears the override)."""
+        pgid = msg.data["pgid"]
+        osds = [int(o) for o in msg.data.get("osds", [])]
+        if self.osdmap.pg_temp.get(pgid, []) != osds:
+            inc = Incremental(epoch=0)
+            inc.new_pg_temp[pgid] = osds
+            await self.propose(inc)
+        await conn.send(Message("osd_pg_temp_reply",
+                                {"pgid": pgid,
+                                 "tid": msg.data.get("tid"),
+                                 "epoch": self.osdmap.epoch}))
+
     async def _h_sub_osdmap(self, conn, msg) -> None:
         self.subscribers[msg.from_name] = conn
         await conn.send(Message("osdmap_full",
@@ -412,6 +427,35 @@ class Monitor:
             inc.new_weights[int(args["osd_id"])] = int(args["weight"])
             await self.propose(inc)
             return True
+        if cmd == "osd pg-upmap-items":
+            pgid = args["pgid"]
+            items = [[int(a), int(b)] for a, b in args["mappings"]]
+            for _, to in items:
+                if not self.osdmap.exists(to):
+                    raise ValueError(f"osd.{to} does not exist")
+            inc = Incremental(epoch=0)
+            inc.new_pg_upmap_items[pgid] = items
+            await self.propose(inc)
+            return pgid
+        if cmd == "osd rm-pg-upmap-items":
+            inc = Incremental(epoch=0)
+            inc.removed_pg_upmap_items.append(args["pgid"])
+            await self.propose(inc)
+            return args["pgid"]
+        if cmd == "osd balancer run":
+            from ..mgr.balancer import balance
+            res = balance(self.osdmap, max_moves=int(args.get("max", 10)))
+            plans = res["plans"]
+            if plans:
+                inc = Incremental(epoch=0)
+                for pgid, items in plans.items():
+                    existing = [list(i) for i in
+                                self.osdmap.pg_upmap_items.get(pgid, [])]
+                    inc.new_pg_upmap_items[pgid] = existing + [
+                        list(i) for i in items]
+                await self.propose(inc)
+            return {"moved": len(plans), "before": res["before"],
+                    "after": res["after"]}
         if cmd == "osd dump":
             return self.osdmap.to_dict()
         if cmd == "osd tree":

@@ -102,6 +102,11 @@ class Incremental:
     new_ec_profiles: dict[str, dict] = field(default_factory=dict)
     removed_ec_profiles: list[str] = field(default_factory=list)
     new_max_osd: int | None = None
+    # pgid -> acting override; [] removes (OSDMap::Incremental
+    # new_pg_temp semantics).  pg_upmap_items: pgid -> [[from, to]...]
+    new_pg_temp: dict[str, list[int]] = field(default_factory=dict)
+    new_pg_upmap_items: dict[str, list] = field(default_factory=dict)
+    removed_pg_upmap_items: list[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         d = asdict(self)
@@ -126,6 +131,10 @@ class Incremental:
             new_ec_profiles=dict(d.get("new_ec_profiles", {})),
             removed_ec_profiles=list(d.get("removed_ec_profiles", [])),
             new_max_osd=d.get("new_max_osd"),
+            new_pg_temp=dict(d.get("new_pg_temp", {})),
+            new_pg_upmap_items=dict(d.get("new_pg_upmap_items", {})),
+            removed_pg_upmap_items=list(
+                d.get("removed_pg_upmap_items", [])),
         )
 
 
@@ -170,6 +179,12 @@ class OSDMap:
         self.pool_names: dict[str, int] = {}
         self.crush = CrushMap()
         self.ec_profiles: dict[str, dict] = {}
+        # explicit placement overrides (OSDMap.cc:2705 _apply_upmap /
+        # pg_temp): upmap items rewrite the raw CRUSH result (balancer
+        # output), pg_temp overrides the ACTING set only (serving
+        # continuity while the up set backfills)
+        self.pg_temp: dict[str, list[int]] = {}
+        self.pg_upmap_items: dict[str, list[tuple[int, int]]] = {}
 
     # -- queries ------------------------------------------------------------
     def exists(self, osd: int) -> bool:
@@ -199,22 +214,60 @@ class OSDMap:
         ps = pool.hash_key(key or name, nspace)
         return pool_id, ps
 
-    def pg_to_up_acting_osds(self, pool_id: int, ps: int) -> list[int]:
+    def _apply_upmap(self, pgid: str, raw: list[int]) -> list[int]:
+        """Rewrite the raw CRUSH result with the pg's upmap items
+        (OSDMap.cc:2705 _apply_upmap): each (from, to) replaces one
+        occurrence, skipped when `to` already appears in the set."""
+        items = self.pg_upmap_items.get(pgid)
+        if not items:
+            return raw
+        out = list(raw)
+        for frm, to in items:
+            if to in out or not self.exists(to):
+                continue
+            for i, o in enumerate(out):
+                if o == frm:
+                    out[i] = to
+                    break
+        return out
+
+    def pg_to_up_acting(self, pool_id: int,
+                        ps: int) -> tuple[list[int], list[int]]:
+        """(up, acting) for a pg (OSDMap.cc:2928 _pg_to_up_acting_osds).
+
+        up = CRUSH + upmap + down-filter; acting = the pg_temp override
+        when one is set (the serving set during backfill), else up."""
         pool = self.pools[pool_id]
+        pgid = self.pg_name(pool_id, ps)
         pps = pool.raw_pg_to_pps(pool.raw_pg_to_pg(ps))
         weights = self.osd_weights()
         raw = crush_do_rule(self.crush, pool.crush_rule, pps, pool.size,
                             weights)
+        raw = self._apply_upmap(pgid, raw)
         # filter nonexistent/down osds (_raw_to_up_osds, OSDMap.cc:2773):
         # replicated pools shift the survivors up; EC pools keep NONE
         # holes because the acting-set position IS the shard id
         if pool.can_shift_osds():
-            out = [o for o in raw
-                   if o != CRUSH_ITEM_NONE and self.is_up(o)]
+            up = [o for o in raw
+                  if o != CRUSH_ITEM_NONE and self.is_up(o)]
         else:
-            out = [o if (o != CRUSH_ITEM_NONE and self.is_up(o))
-                   else CRUSH_ITEM_NONE for o in raw]
-        return out
+            up = [o if (o != CRUSH_ITEM_NONE and self.is_up(o))
+                  else CRUSH_ITEM_NONE for o in raw]
+        temp = self.pg_temp.get(pgid)
+        if temp:
+            acting = [o if (o != CRUSH_ITEM_NONE and self.is_up(o))
+                      else CRUSH_ITEM_NONE for o in temp]
+            if pool.can_shift_osds():
+                acting = [o for o in acting if o != CRUSH_ITEM_NONE]
+            if not acting:
+                acting = up
+        else:
+            acting = up
+        return up, acting
+
+    def pg_to_up_acting_osds(self, pool_id: int, ps: int) -> list[int]:
+        """Acting set (what clients target); see pg_to_up_acting."""
+        return self.pg_to_up_acting(pool_id, ps)[1]
 
     def pg_primary(self, up: list[int]) -> int | None:
         for o in up:
@@ -259,12 +312,27 @@ class OSDMap:
             spec = self.pools.pop(pid, None)
             if spec:
                 self.pool_names.pop(spec.name, None)
+            # pool ids are reused (max+1): stale placement overrides
+            # must not leak onto a future pool with the same id
+            prefix = f"{pid}."
+            for d in (self.pg_temp, self.pg_upmap_items):
+                for pgid in [k for k in d if k.startswith(prefix)]:
+                    d.pop(pgid)
         if inc.new_crush is not None:
             self.crush = crush_from_dict(inc.new_crush)
         for name, profile in inc.new_ec_profiles.items():
             self.ec_profiles[name] = dict(profile)
         for name in inc.removed_ec_profiles:
             self.ec_profiles.pop(name, None)
+        for pgid, osds in inc.new_pg_temp.items():
+            if osds:
+                self.pg_temp[pgid] = list(osds)
+            else:
+                self.pg_temp.pop(pgid, None)
+        for pgid, items in inc.new_pg_upmap_items.items():
+            self.pg_upmap_items[pgid] = [tuple(i) for i in items]
+        for pgid in inc.removed_pg_upmap_items:
+            self.pg_upmap_items.pop(pgid, None)
 
     # -- serialization ------------------------------------------------------
     def to_dict(self) -> dict:
@@ -279,6 +347,9 @@ class OSDMap:
             "pools": {str(p): asdict(s) for p, s in self.pools.items()},
             "crush": crush_to_dict(self.crush),
             "ec_profiles": self.ec_profiles,
+            "pg_temp": self.pg_temp,
+            "pg_upmap_items": {k: [list(i) for i in v]
+                               for k, v in self.pg_upmap_items.items()},
         }
 
     @classmethod
@@ -297,4 +368,8 @@ class OSDMap:
             m.pool_names[spec.name] = int(p)
         m.crush = crush_from_dict(d["crush"])
         m.ec_profiles = dict(d.get("ec_profiles", {}))
+        m.pg_temp = {k: list(v) for k, v in d.get("pg_temp", {}).items()}
+        m.pg_upmap_items = {k: [tuple(i) for i in v]
+                            for k, v in d.get("pg_upmap_items",
+                                              {}).items()}
         return m

@@ -159,7 +159,10 @@ class PGBackend:
         the staleness invisible.  The reference wedges the op until the
         laggard commits or is marked down (all_commit); this framework
         heals forward instead -- the laggard is recorded missing that
-        object and recovery re-pushes the full object."""
+        object and recovery re-pushes the full object.  The op only
+        ACKS when commits (local + acked peers) still reach the pool's
+        min_size; below that the durability story is too thin and the
+        error surfaces to the client."""
         if not awaiting:
             return
         replies = await self.osd.fanout_and_wait(awaiting, collect=True)
@@ -171,6 +174,11 @@ class PGBackend:
             ms = self.pg.peer_missing.setdefault(osd_id, MissingSet())
             ms.add(entry.oid, need=entry.version, have=ZERO)
         self.pg.kick_recovery()
+        n_committed = 1 + len(acked)         # local shard + repliers
+        if n_committed < self.pg.pool.min_size:
+            raise TimeoutError(
+                f"{entry.oid}: only {n_committed} commits < min_size "
+                f"{self.pg.pool.min_size} (laggards {laggards})")
 
 
 def build_pg_backend(pg):

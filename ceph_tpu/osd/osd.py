@@ -211,16 +211,16 @@ class OSD:
                 profile = self.osdmap.ec_profiles.get(
                     pool.erasure_code_profile)
             for ps in range(pool.pg_num):
-                up = self.osdmap.pg_to_up_acting_osds(pool_id, ps)
+                up, acting = self.osdmap.pg_to_up_acting(pool_id, ps)
                 pgid = self.osdmap.pg_name(pool_id, ps)
-                involved = self.whoami in up
+                involved = self.whoami in up or self.whoami in acting
                 pg = self.pgs.get(pgid)
                 if pg is None:
                     if not involved:
                         continue
                     pg = PG(self, pgid, pool, profile)
                     self.pgs[pgid] = pg
-                changed = pg.update_mapping(up, list(up), epoch)
+                changed = pg.update_mapping(up, acting, epoch)
                 if changed and pg.is_primary():
                     pg.kick_peering()
         # drop PGs for deleted pools
@@ -281,14 +281,27 @@ class OSD:
         profile = self.osdmap.ec_profiles.get(
             pool.erasure_code_profile) if pool.is_erasure() else None
         pg = PG(self, pgid, pool, profile)
-        ps = int(pgid.split(".")[1])
-        up = self.osdmap.pg_to_up_acting_osds(pool_id, ps)
-        pg.update_mapping(up, list(up), self.osdmap.epoch)
+        ps = int(pgid.split(".")[1], 16)
+        up, acting = self.osdmap.pg_to_up_acting(pool_id, ps)
+        pg.update_mapping(up, acting, self.osdmap.epoch)
         self.pgs[pgid] = pg
         return pg
 
     def osd_is_up(self, osd: int) -> bool:
         return osd == self.whoami or self.osdmap.is_up(osd)
+
+    def request_pg_temp(self, pgid: str, osds: list[int]) -> None:
+        """Fire-and-forget MOSDPGTemp to the mon (an empty list clears
+        the override); the map change comes back as an incremental."""
+        async def _send():
+            try:
+                await self._mon_request(
+                    "osd_pg_temp", {"pgid": pgid, "osds": osds},
+                    reply_type="osd_pg_temp_reply", timeout=10)
+            except (asyncio.TimeoutError, ConnectionError, OSError):
+                pass                 # re-requested on the next peering
+        t = asyncio.ensure_future(_send())
+        self._tasks.append(t)
 
     # -- peer RPC -----------------------------------------------------------
     def _peer_addr(self, osd: int) -> tuple[str, int]:

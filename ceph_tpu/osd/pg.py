@@ -246,6 +246,16 @@ class PG:
             auth_entries = self.peer_log_entries[best_osd]
             if primary_gap:
                 self.info.backfill_complete = False
+                if (self.pool.can_shift_osds()
+                        and self.acting == self.up
+                        and best_info.backfill_complete):
+                    # our data is gapped but a complete peer exists:
+                    # hand it the primary role via pg_temp so clients
+                    # are served at full speed while IT backfills US
+                    # (OSDMonitor pg_temp / choose_acting semantics)
+                    temp = [best_osd] + [o for o in self.up
+                                         if o >= 0 and o != best_osd]
+                    self.osd.request_pg_temp(self.pgid, temp)
             divergent = self.log.merge(auth_entries, best_info, self.missing)
             self._clean_divergent(divergent)
             self._reindex_reqids()
@@ -265,11 +275,17 @@ class PG:
             if (pinfo.last_update < auth_log.tail
                     or not pinfo.backfill_complete):
                 # peer's log cannot bridge: incremental cursor-driven
-                # backfill, resuming from the peer's PERSISTED
-                # last_backfill (a fresh gap resets it via activate)
+                # backfill.  The peer's persisted last_backfill is only
+                # a valid resume point while its log still OVERLAPS the
+                # auth log -- across a fresh trim gap, writes below the
+                # cursor may hide in the lost window, so the scan must
+                # restart (activate resets the peer's own copy the same
+                # way)
                 self.backfill_targets.add(osd_id)
                 cursor = (pinfo.last_backfill
-                          if not pinfo.backfill_complete else "")
+                          if (not pinfo.backfill_complete
+                              and pinfo.last_update >= auth_log.tail)
+                          else "")
                 self.backfill_info[osd_id] = {
                     "cursor": cursor, "inflight": {}, "pushed": set(),
                     "dirty": set(), "done": False}
@@ -310,6 +326,10 @@ class PG:
         if (self.missing or any(self.peer_missing.values())
                 or self.backfill_targets):
             self.kick_recovery()
+        else:
+            # nothing to recover: a leftover pg_temp override (e.g. the
+            # target finished under a previous interval) clears here
+            self._maybe_clear_pg_temp()
 
     def object_vers(self) -> dict[str, tuple[int, int]]:
         """oid -> stored version stamp for every object in this PG."""
@@ -766,6 +786,7 @@ class PG:
                     # the whole round (PrimaryLogPG interleaves recovery
                     # with ops the same way, per-object blocking only)
                     await self._do_backfills()
+                    self._maybe_clear_pg_temp()
                     async with self.lock:
                         self.persist_meta()
                 except (ConnectionError, OSError, asyncio.TimeoutError):
@@ -813,6 +834,18 @@ class PG:
             for ev in evs:
                 await ev.wait()
 
+    @staticmethod
+    def _push_payload(oid: str, payload: dict) -> tuple[dict, list]:
+        """Wire form of a recovery/backfill payload (shared by push,
+        backfill push and the pull reply -- one place owns the format)."""
+        return ({"oid": oid,
+                 "absent": payload.get("absent", False),
+                 "xattrs": {k: v.hex()
+                            for k, v in payload["xattrs"].items()},
+                 "omap": {k: v.hex()
+                          for k, v in payload["omap"].items()}},
+                [payload["data"]])
+
     async def _backfill_push(self, peer: int, oid: str) -> bool:
         """Push one object (or its absence) to a backfill target with
         the per-object interlock.  Returns True on ack."""
@@ -828,15 +861,11 @@ class PG:
                 bi["inflight"][oid] = ev
             payload = await self.backend.read_recovery_payload(
                 oid, self._shard_of(peer))
+            data, segs = self._push_payload(oid, payload)
+            data["pgid"] = self.pgid
             replies = await self.osd.fanout_and_wait(
-                [(peer, "pg_push",
-                  {"pgid": self.pgid, "oid": oid,
-                   "absent": payload.get("absent", False),
-                   "xattrs": {k: v.hex()
-                              for k, v in payload["xattrs"].items()},
-                   "omap": {k: v.hex()
-                            for k, v in payload["omap"].items()}},
-                  [payload["data"]])], collect=True, timeout=10)
+                [(peer, "pg_push", data, segs)],
+                collect=True, timeout=10)
             if not replies or replies[0].data.get("err"):
                 return False
             bi["pushed"].add(oid)
@@ -920,6 +949,17 @@ class PG:
             pinfo = self.peer_info.get(peer)
             if pinfo is not None:
                 pinfo.backfill_complete = True
+
+    def _maybe_clear_pg_temp(self) -> None:
+        """Every up member is complete: drop the pg_temp override so
+        the CRUSH primary takes back over."""
+        if (not self.backfill_targets and self.acting != self.up
+                and self.osd.osdmap.pg_temp.get(self.pgid)
+                and not self.missing
+                and all(pi.backfill_complete
+                        for o, pi in self.peer_info.items()
+                        if o in self.up)):
+            self.osd.request_pg_temp(self.pgid, [])
 
     async def _do_backfills(self) -> None:
         """Advance every backfill target under reservation slots
@@ -1006,13 +1046,7 @@ class PG:
         oid = msg.data["oid"]
         shard = msg.data.get("shard", 0)
         payload = await self.backend.read_recovery_payload(oid, shard)
-        return ({"oid": oid,
-                 "absent": payload.get("absent", False),
-                 "xattrs": {k: v.hex()
-                            for k, v in payload["xattrs"].items()},
-                 "omap": {k: v.hex()
-                          for k, v in payload["omap"].items()}},
-                [payload["data"]])
+        return self._push_payload(oid, payload)
 
     async def _push_object(self, peer: int, oid: str) -> None:
         ms = self.peer_missing.get(peer)
@@ -1020,14 +1054,10 @@ class PG:
             return
         payload = await self.backend.read_recovery_payload(
             oid, self._shard_of(peer))
+        data, segs = self._push_payload(oid, payload)
+        data["pgid"] = self.pgid
         replies = await self.osd.fanout_and_wait(
-            [(peer, "pg_push",
-              {"pgid": self.pgid, "oid": oid,
-               "absent": payload.get("absent", False),
-               "xattrs": {k: v.hex()
-                          for k, v in payload["xattrs"].items()},
-               "omap": {k: v.hex() for k, v in payload["omap"].items()}},
-              [payload["data"]])], collect=True, timeout=10)
+            [(peer, "pg_push", data, segs)], collect=True, timeout=10)
         if not replies or replies[0].data.get("err"):
             return                      # peer not ready; retried later
         ms.items.pop(oid, None)

@@ -1,0 +1,197 @@
+"""pg_temp / pg_upmap_items / balancer (OSDMap.cc:2705 _apply_upmap,
+OSDMapMapping.h:175, mgr balancer upmap mode)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.mon.osdmap import OSDMap, Incremental
+
+from test_osd_cluster import Cluster, make_cluster, read_result, run
+from test_backfill import wait_for
+
+
+
+
+def test_upmap_items_rewrite_and_serialization():
+    async def main():
+        c = await make_cluster(4)
+        try:
+            await c.command("osd pool create",
+                            {"name": "rbd", "pg_num": 8, "size": 3,
+                             "min_size": 2})
+            m = c.mon.osdmap
+            pool_id = m.pool_names["rbd"]
+            # find a pg and an osd outside it
+            for ps in range(8):
+                up, acting = m.pg_to_up_acting(pool_id, ps)
+                outside = [o for o in m.osds if o not in up]
+                if outside:
+                    break
+            pgid = m.pg_name(pool_id, ps)
+            frm, to = up[1], outside[0]
+            await c.command("osd pg-upmap-items",
+                            {"pgid": pgid, "mappings": [[frm, to]]})
+            up2, acting2 = c.mon.osdmap.pg_to_up_acting(pool_id, ps)
+            assert to in up2 and frm not in up2, (up, up2)
+            assert acting2 == up2
+            # round-trips through map serialization
+            m2 = OSDMap.from_dict(c.mon.osdmap.to_dict())
+            assert m2.pg_upmap_items[pgid] == [(frm, to)]
+            assert m2.pg_to_up_acting(pool_id, ps)[0] == up2
+            # removal restores CRUSH placement
+            await c.command("osd rm-pg-upmap-items", {"pgid": pgid})
+            up3, _ = c.mon.osdmap.pg_to_up_acting(pool_id, ps)
+            assert up3 == up
+            # data still served through the remap cycle
+            await c.osd_op("rbd", "um-obj", [
+                {"op": "writefull", "data": b"um" * 40}])
+            reply = await c.osd_op("rbd", "um-obj", [
+                {"op": "read", "off": 0, "len": None}])
+            r, data = read_result(reply)
+            assert r.get("ok") and data == b"um" * 40
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_upmap_moves_data_to_new_osd():
+    """After an upmap remap, the new member receives the pg's objects
+    (backfill/recovery through the acting change)."""
+    async def main():
+        c = await make_cluster(4, osd_config={
+            "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 2.0})
+        try:
+            await c.command("osd pool create",
+                            {"name": "rbd", "pg_num": 4, "size": 3,
+                             "min_size": 2})
+            payloads = {}
+            for i in range(20):
+                oid = f"mv-{i}"
+                data = f"mv{i}".encode() * 25
+                await c.osd_op("rbd", oid, [
+                    {"op": "writefull", "data": data}])
+                payloads[oid] = data
+            m = c.mon.osdmap
+            pool_id = m.pool_names["rbd"]
+            pgid0, _, up0 = c.target_for("rbd", "mv-0")
+            ps0 = int(pgid0.split(".")[1], 16)
+            outside = [o for o in m.osds if o not in up0]
+            assert outside
+            frm, to = up0[-1], outside[0]
+            await c.command("osd pg-upmap-items",
+                            {"pgid": pgid0, "mappings": [[frm, to]]})
+            new_osd = next(o for o in c.osds if o.whoami == to)
+
+            def migrated():
+                pg = new_osd.pgs.get(pgid0)
+                if pg is None or not pg.info.backfill_complete:
+                    return False
+                for oid, want in payloads.items():
+                    _, ps = m.object_to_pg(pool_id, oid)
+                    if m.pg_name(pool_id, ps) != pgid0:
+                        continue
+                    try:
+                        if new_osd.store.read(f"pg_{pgid0}", oid,
+                                              0, None) != want:
+                            return False
+                    except FileNotFoundError:
+                        return False
+                return True
+            await wait_for(migrated, timeout=60,
+                           msg="objects migrated to upmap target")
+            # reads still correct for every object
+            for oid, want in payloads.items():
+                reply = await c.osd_op("rbd", oid, [
+                    {"op": "read", "off": 0, "len": None}])
+                r, data = read_result(reply)
+                assert r.get("ok") and data == want, oid
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_balancer_reduces_skew():
+    """The balancer emits upmap items that shrink the PGs/OSD spread."""
+    async def main():
+        c = await make_cluster(5)
+        try:
+            await c.command("osd pool create",
+                            {"name": "rbd", "pg_num": 64, "size": 3,
+                             "min_size": 2})
+            from ceph_tpu.mgr.balancer import pg_distribution
+            before = pg_distribution(c.mon.osdmap)
+            res = await c.command("osd balancer run", {"max": 20})
+            after = pg_distribution(c.mon.osdmap)
+            assert res["moved"] >= 0
+            spread_b = before["max"] - before["min"]
+            spread_a = after["max"] - after["min"]
+            assert spread_a <= spread_b, (before, after)
+            assert spread_a <= 1 or res["moved"] == 0, (before, after)
+            # mappings still valid: all pgs keep 3 distinct up osds
+            m = c.mon.osdmap
+            pool_id = m.pool_names["rbd"]
+            for ps in range(64):
+                up, _ = m.pg_to_up_acting(pool_id, ps)
+                assert len(up) == 3 and len(set(up)) == 3, (ps, up)
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_pg_temp_hands_primary_to_complete_peer():
+    """A revived, log-gapped CRUSH primary must hand serving to a
+    complete peer via pg_temp, then take back over when backfilled."""
+    import ceph_tpu.osd.pg as pgmod
+    from ceph_tpu.osd import OSD
+
+    async def main():
+        old_batch = pgmod.SCAN_BATCH
+        pgmod.SCAN_BATCH = 32
+        cfg = {"osd_heartbeat_interval": 0.2,
+               "osd_heartbeat_grace": 2.0}
+        c = await make_cluster(3, osd_config=cfg)
+        try:
+            await c.command("osd pool create",
+                            {"name": "rbd", "pg_num": 1, "size": 3,
+                             "min_size": 2})
+            pgid, primary, up = c.target_for("rbd", "x")
+            # kill the PRIMARY and gap the log
+            posd = next(o for o in c.osds if o.whoami == primary)
+            puuid, pstore = posd.uuid, posd.store
+            await posd.stop()
+            c.osds = [o for o in c.osds if o.whoami != primary]
+            await wait_for(lambda: not c.mon.osdmap.is_up(primary),
+                           msg="primary down")
+            for i in range(pgmod.LOG_CAP + 60):
+                await c.osd_op("rbd", f"o-{i:05d}", [
+                    {"op": "writefull", "data": f"d{i}".encode() * 10}])
+            revived = OSD(uuid=puuid, whoami=primary, store=pstore,
+                          host=f"host{primary}", config=cfg)
+            await revived.start(c.mon.msgr.addr)
+            c.osds.append(revived)
+            # the gapped CRUSH primary must yield via pg_temp
+            await wait_for(
+                lambda: pgid in c.mon.osdmap.pg_temp, timeout=30,
+                msg="pg_temp override requested")
+            temp = c.mon.osdmap.pg_temp[pgid]
+            assert temp[0] != primary, temp
+            # writes are served by the temp primary DURING backfill
+            await asyncio.wait_for(c.osd_op("rbd", "during-temp", [
+                {"op": "writefull", "data": b"served"}]), 15)
+            # once complete, the override clears and CRUSH rules again
+            await wait_for(
+                lambda: pgid not in c.mon.osdmap.pg_temp, timeout=90,
+                msg="pg_temp cleared after backfill")
+            reply = await c.osd_op("rbd", "during-temp", [
+                {"op": "read", "off": 0, "len": None}])
+            r, data = read_result(reply)
+            assert r.get("ok") and data == b"served"
+            reply = await c.osd_op("rbd", "o-00000", [
+                {"op": "read", "off": 0, "len": None}])
+            r, data = read_result(reply)
+            assert r.get("ok") and data == b"d0" * 10
+        finally:
+            pgmod.SCAN_BATCH = old_batch
+            await c.stop()
+    run(main())

@@ -130,3 +130,19 @@ def balance(osdmap, max_moves: int = 10) -> dict:
 
 def compute_upmaps(osdmap, max_moves: int = 10) -> dict[str, list]:
     return balance(osdmap, max_moves)["plans"]
+
+
+def compact_items(existing: list, new: list) -> list:
+    """Fold new upmap items into an existing chain: (a,b)+(b,c)->(a,c),
+    identities drop (OSDMap::calc_pg_upmaps resolves chains the same
+    way so per-pg item lists do not grow without bound)."""
+    items = [tuple(i) for i in existing]
+    for frm, to in (tuple(i) for i in new):
+        for idx, (x, y) in enumerate(items):
+            if y == frm:
+                frm = x
+                items.pop(idx)
+                break
+        if frm != to:
+            items.append((frm, to))
+    return [list(i) for i in items]

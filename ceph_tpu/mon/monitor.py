@@ -90,8 +90,6 @@ class Monitor:
         self.incrementals: dict[int, Incremental] = {}
         self.subscribers: dict[str, object] = {}   # peer name -> Connection
         self.failure_reports: dict[int, set[str]] = defaultdict(set)
-        self.osd_hosts: dict[int, str] = {}
-        self.osd_uuids: dict[str, int] = {}
         self._pending_lock = asyncio.Lock()
         self._tick_task: asyncio.Task | None = None
         self._down_since: dict[int, float] = {}
@@ -99,6 +97,21 @@ class Monitor:
         self.quorum: set[int] = {rank}
         self.accepts: dict[int, set[int]] = {}
         self._commit_waiters: dict[int, asyncio.Future] = {}
+        # elections (ElectionLogic analog): epoch odd while electing,
+        # even when a leader holds a quorum; the LOWEST alive rank wins
+        # and data consistency is the collect phase's job, not the
+        # election's (Elector.cc / Paxos.cc:154)
+        self.election_epoch = 0
+        self.leader: int | None = None
+        self._election_acks: set[int] = set()
+        self._election_task: asyncio.Task | None = None
+        self._lease_expire = 0.0       # peon: leader lease deadline
+        self._lease_acks: set[int] = set()
+        self._lease_misses = 0
+        self._lease_round = 0
+        self._collect_replies: dict[int, dict] = {}
+        self._collected = False        # leader ran collect this term
+        self._stopped = False
         # observability (Paxos registers PerfCounters too, Paxos.cc:117)
         self.perf = PerfCountersCollection()
         self.perf_paxos = self.perf.create("paxos")
@@ -143,15 +156,225 @@ class Monitor:
         return addr
 
     async def stop(self) -> None:
+        self._stopped = True
         if self.admin_socket is not None:
             await self.admin_socket.stop()
         if self._tick_task:
             self._tick_task.cancel()
+        if self._election_task:
+            self._election_task.cancel()
         await self.msgr.shutdown()
 
     @property
     def is_leader(self) -> bool:
-        return self.rank == min(self.quorum)
+        return self.leader == self.rank or self._n_mons() <= 1
+
+    def _n_mons(self) -> int:
+        return len([a for a in self.peer_addrs if a is not None])
+
+    # -- elections ----------------------------------------------------------
+    def _mon_peers(self) -> list[int]:
+        return [r for r, a in enumerate(self.peer_addrs)
+                if a is not None and r != self.rank]
+
+    async def _send_mon(self, r: int, msg: Message) -> None:
+        try:
+            await self.msgr.send(tuple(self.peer_addrs[r]), f"mon.{r}",
+                                 msg)
+        except (ConnectionError, OSError):
+            pass
+
+    def start_election(self) -> None:
+        if self._n_mons() <= 1:
+            self.leader = self.rank
+            self.quorum = {self.rank}
+            return
+        if self._election_task is None or self._election_task.done():
+            self._election_task = asyncio.ensure_future(
+                self._run_election())
+
+    async def _run_election(self) -> None:
+        """Campaign until a leader (us or a lower rank) holds a quorum.
+
+        Lowest alive rank wins; a higher-ranked campaigner defers as
+        soon as it sees a lower rank's proposal (ElectionLogic's
+        rank-priority deferral)."""
+        try:
+            backoff = 0.3
+            while not self._stopped:
+                # every campaign uses a FRESH odd epoch: reusing one
+                # would let acks from an abandoned round count toward a
+                # relaunched candidacy (double victory)
+                self.election_epoch += 2 if self.election_epoch % 2 \
+                    else 1
+                self.leader = None
+                self._election_acks = {self.rank}
+                epoch = self.election_epoch
+                for r in self._mon_peers():
+                    await self._send_mon(r, Message(
+                        "mon_election_propose",
+                        {"epoch": epoch, "rank": self.rank,
+                         "last_committed": self.store.last_committed()}))
+                await asyncio.sleep(backoff)
+                if self.election_epoch != epoch or self.leader is not None:
+                    return        # someone else won (or a newer round)
+                if len(self._election_acks) >= self._majority():
+                    await self._declare_victory(epoch)
+                    return
+                self.election_epoch += 2   # new odd round
+                backoff = min(2.0, backoff * 1.7)
+        except asyncio.CancelledError:
+            pass
+
+    async def _declare_victory(self, epoch: int) -> None:
+        self.election_epoch = epoch + 1        # even: stable
+        self.leader = self.rank
+        self.quorum = set(self._election_acks)
+        self._lease_misses = 0
+        self._collected = False
+        for r in sorted(self.quorum - {self.rank}):
+            await self._send_mon(r, Message(
+                "mon_election_victory",
+                {"epoch": self.election_epoch, "rank": self.rank,
+                 "quorum": sorted(self.quorum)}))
+        # recover any in-flight value before serving (Paxos collect)
+        await self._paxos_collect()
+
+    async def _h_mon_election_propose(self, conn, msg) -> None:
+        epoch, rank = msg.data["epoch"], msg.data["rank"]
+        if epoch < self.election_epoch:
+            return                              # stale round
+        if epoch > self.election_epoch:
+            self.election_epoch = epoch
+            self.leader = None
+        if rank < self.rank:
+            # defer to the lower rank; stop our own candidacy and hold
+            # off re-campaigning long enough for its victory to land
+            # (without the hold, the tick loop would relaunch us at a
+            # higher epoch and depose the winner -- election flapping)
+            if self._election_task and not self._election_task.done():
+                self._election_task.cancel()
+            self._defer_until = time.monotonic() + 1.5
+            # the PROMISE: at most ONE ack per epoch -- acking a second
+            # candidate in the same epoch (even a lower rank) could
+            # hand two candidates a majority at once.  The lower rank
+            # simply wins the next round instead.
+            acked = getattr(self, "_acked", None)
+            if acked is None or epoch > acked[0]:
+                self._acked = (epoch, rank)
+                await self._send_mon(rank, Message(
+                    "mon_election_ack",
+                    {"epoch": epoch, "rank": self.rank}))
+        elif (self.leader is None
+              and time.monotonic() > getattr(self, "_defer_until", 0.0)):
+            self.start_election()               # outrank them: campaign
+
+    async def _h_mon_election_ack(self, conn, msg) -> None:
+        if msg.data["epoch"] == self.election_epoch:
+            self._election_acks.add(msg.data["rank"])
+
+    async def _h_mon_election_victory(self, conn, msg) -> None:
+        epoch = msg.data["epoch"]
+        if epoch < self.election_epoch:
+            return
+        if self._election_task and not self._election_task.done():
+            self._election_task.cancel()
+        self.election_epoch = epoch
+        self.leader = msg.data["rank"]
+        self.quorum = set(msg.data["quorum"])
+        self._lease_expire = (time.monotonic()
+                              + self.config["mon_lease"])
+
+    # -- leases (Paxos lease: peons trust the leader while fresh) -----------
+    async def _h_mon_lease(self, conn, msg) -> None:
+        if msg.data["epoch"] != self.election_epoch:
+            return
+        self._lease_expire = time.monotonic() + self.config["mon_lease"]
+        await conn.send(Message("mon_lease_ack",
+                                {"epoch": self.election_epoch,
+                                 "rank": self.rank}))
+
+    async def _h_mon_lease_ack(self, conn, msg) -> None:
+        if msg.data["epoch"] == self.election_epoch:
+            self._lease_acks.add(msg.data["rank"])
+
+    # -- paxos collect (Paxos.cc:154-613) -----------------------------------
+    async def _paxos_collect(self) -> None:
+        """New-leader recovery: learn every committed version the
+        quorum has, re-propose any accepted-but-uncommitted value, and
+        catch lagging peons up.  Nothing is served until this runs."""
+        peers = sorted(self.quorum - {self.rank})
+        self._collect_replies: dict[int, dict] = {}
+        for r in peers:
+            await self._send_mon(r, Message(
+                "paxos_collect",
+                {"epoch": self.election_epoch,
+                 "last_committed": self.store.last_committed()}))
+        deadline = time.monotonic() + 5.0
+        while (len(self._collect_replies) < len(peers)
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.05)
+        # 1. adopt committed versions we missed (they are FACTS)
+        for rep in self._collect_replies.values():
+            for v_str, blob_hex in sorted(rep.get("missing", {}).items(),
+                                          key=lambda kv: int(kv[0])):
+                v = int(v_str)
+                if v == self.store.last_committed() + 1:
+                    self._commit_local(v, bytes.fromhex(blob_hex))
+        # 2. re-propose the HIGHEST-BALLOT accepted-but-uncommitted
+        # value (classic phase-1: among competing accepted values, the
+        # newest term's may already be committed somewhere unseen)
+        next_v = self.store.last_committed() + 1
+        best: tuple[int, bytes] | None = None
+        if (blob := self.store.get_kv(f"pending_{next_v}")) is not None:
+            ballot = int(self.store.get_kv(f"pending_e_{next_v}")
+                         or b"0")
+            best = (ballot, blob)
+        for rep in self._collect_replies.values():
+            u = rep.get("uncommitted")
+            if u and int(u[0]) == next_v:
+                ballot = int(u[2]) if len(u) > 2 else 0
+                if best is None or ballot > best[0]:
+                    best = (ballot, bytes.fromhex(u[1]))
+        if best is not None:
+            inc = Incremental.from_dict(json.loads(best[1]))
+            inc.epoch = 0
+            await self._propose_locked(inc, recovery=True)
+        # 3. catch lagging peons up to our committed state
+        for r, rep in self._collect_replies.items():
+            for v in range(int(rep["last_committed"]) + 1,
+                           self.store.last_committed() + 1):
+                blob = self.store.get(v)
+                if blob is not None:
+                    await self._send_mon(r, Message(
+                        "paxos_commit",
+                        {"version": v, "value": blob.decode()}))
+        self._collected = True
+
+    async def _h_paxos_collect(self, conn, msg) -> None:
+        if msg.data["epoch"] != self.election_epoch:
+            return
+        leader_lc = int(msg.data["last_committed"])
+        mine = self.store.last_committed()
+        missing = {str(v): self.store.get(v).hex()
+                   for v in range(leader_lc + 1, mine + 1)
+                   if self.store.get(v) is not None}
+        uncommitted = None
+        pending = self.store.get_kv(f"pending_{mine + 1}")
+        if pending is not None:
+            ballot = int(self.store.get_kv(f"pending_e_{mine + 1}")
+                         or b"0")
+            uncommitted = [mine + 1, pending.hex(), ballot]
+        await conn.send(Message("paxos_last",
+                                {"epoch": self.election_epoch,
+                                 "rank": self.rank,
+                                 "last_committed": mine,
+                                 "missing": missing,
+                                 "uncommitted": uncommitted}))
+
+    async def _h_paxos_last(self, conn, msg) -> None:
+        if msg.data["epoch"] == self.election_epoch:
+            self._collect_replies[msg.data["rank"]] = msg.data
 
     def _majority(self) -> int:
         return len([a for a in self.peer_addrs if a is not None]) // 2 + 1
@@ -164,7 +387,8 @@ class Monitor:
             await self._propose_locked(inc)
         self.perf_paxos.inc("commit")
 
-    async def _propose_locked(self, inc: Incremental) -> None:
+    async def _propose_locked(self, inc: Incremental,
+                              recovery: bool = False) -> None:
         async with self._pending_lock:
             inc.epoch = self.osdmap.epoch + 1
             blob = json.dumps(inc.to_dict()).encode()
@@ -173,6 +397,26 @@ class Monitor:
             if n_peers <= 1:
                 self._commit_local(version, blob)
             else:
+                # a proposal that lands while an election is settling
+                # waits for the term AND for the collect phase: serving
+                # before collect could assign a version number the old
+                # quorum already committed elsewhere (recovery=True is
+                # the collect phase's own re-proposal)
+                deadline = time.monotonic() + 5.0
+                while (time.monotonic() < deadline
+                       and (self.leader is None
+                            or (self.is_leader and not recovery
+                                and not self._collected))):
+                    await asyncio.sleep(0.1)
+                if not self.is_leader or (not recovery
+                                          and not self._collected):
+                    raise RuntimeError(
+                        f"mon.{self.rank} cannot propose "
+                        f"(leader={self.leader}, "
+                        f"collected={self._collected})")
+                inc.epoch = self.osdmap.epoch + 1
+                blob = json.dumps(inc.to_dict()).encode()
+                version = inc.epoch
                 self.accepts[version] = {self.rank}
                 fut = asyncio.get_event_loop().create_future()
                 self._commit_waiters[version] = fut
@@ -184,6 +428,7 @@ class Monitor:
                             tuple(addr), f"mon.{r}",
                             Message("paxos_begin",
                                     {"version": version,
+                                     "e": self.election_epoch,
                                      "value": blob.decode()}))
                     except (ConnectionError, OSError):
                         pass
@@ -196,22 +441,17 @@ class Monitor:
         inc = Incremental.from_dict(json.loads(blob))
         self.osdmap.apply_incremental(inc)
         self.incrementals[inc.epoch] = inc
+        # EVERY mon pushes deltas to its own subscribers (peons serve
+        # map subscriptions too; the reference mons all publish)
+        if self.subscribers:
+            t = asyncio.ensure_future(self._push_subscribers(inc))
+            self._bg_tasks = getattr(self, "_bg_tasks", set())
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
 
-    async def _publish(self, inc: Incremental) -> None:
-        # distribute commit to peons + map delta to subscribers
-        n_peers = len([a for a in self.peer_addrs if a is not None])
-        if n_peers > 1:
-            for r, addr in enumerate(self.peer_addrs):
-                if r == self.rank or addr is None:
-                    continue
-                try:
-                    await self.msgr.send(
-                        tuple(addr), f"mon.{r}",
-                        Message("paxos_commit", {"version": inc.epoch}))
-                except (ConnectionError, OSError):
-                    pass
+    async def _push_subscribers(self, inc: Incremental) -> None:
         dead = []
-        for name, conn in self.subscribers.items():
+        for name, conn in list(self.subscribers.items()):
             try:
                 await conn.send(Message("osdmap_inc",
                                         {"inc": inc.to_dict()}))
@@ -219,6 +459,24 @@ class Monitor:
                 dead.append(name)
         for name in dead:
             self.subscribers.pop(name, None)
+
+    async def _publish(self, inc: Incremental) -> None:
+        # distribute commit (with its value: a peon that missed the
+        # begin still converges) to the quorum
+        n_peers = len([a for a in self.peer_addrs if a is not None])
+        if n_peers > 1:
+            blob = json.dumps(inc.to_dict()).encode()
+            for r, addr in enumerate(self.peer_addrs):
+                if r == self.rank or addr is None:
+                    continue
+                try:
+                    await self.msgr.send(
+                        tuple(addr), f"mon.{r}",
+                        Message("paxos_commit",
+                                {"version": inc.epoch,
+                                 "value": blob.decode()}))
+                except (ConnectionError, OSError):
+                    pass
 
     # -- dispatch -----------------------------------------------------------
     async def _dispatch(self, conn, msg: Message) -> None:
@@ -229,9 +487,19 @@ class Monitor:
     async def _h_paxos_begin(self, conn, msg) -> None:
         version = msg.data["version"]
         blob = msg.data["value"].encode()
-        # peon: accept if it extends our log
+        # peon: accept if it extends our log AND comes from the current
+        # term (a deposed leader's in-flight begin must not be accepted
+        # into the new leader's quorum)
+        e = msg.data.get("e")
+        if e is not None and e != self.election_epoch:
+            return
         if version == self.store.last_committed() + 1:
             self.store.put_kv(f"pending_{version}", blob)
+            # record the BALLOT (term) with the acceptance: collect
+            # picks the highest-ballot value among competing pendings
+            self.store.put_kv(f"pending_e_{version}",
+                              str(e if e is not None
+                                  else self.election_epoch).encode())
             await conn.send(Message("paxos_accept", {"version": version,
                                                      "rank": self.rank}))
 
@@ -248,40 +516,59 @@ class Monitor:
 
     async def _h_paxos_commit(self, conn, msg) -> None:
         version = msg.data["version"]
-        blob = self.store.get_kv(f"pending_{version}")
+        # commit messages may carry the value (collect catch-up path);
+        # otherwise it was stashed at begin time
+        if "value" in msg.data:
+            blob = msg.data["value"].encode()
+        else:
+            blob = self.store.get_kv(f"pending_{version}")
         if blob is not None and version == self.store.last_committed() + 1:
             self._commit_local(version, blob)
 
     async def _h_mon_probe(self, conn, msg) -> None:
-        self.quorum.add(msg.data["rank"])
-        await conn.send(Message("mon_probe_ack", {"rank": self.rank}))
+        # discovery only: quorum membership comes from ELECTIONS, never
+        # from a probe (a stale or partitioned mon must not inject
+        # itself into an active quorum)
+        await conn.send(Message("mon_probe_ack",
+                                {"rank": self.rank,
+                                 "election_epoch": self.election_epoch,
+                                 "leader": self.leader}))
 
     async def _h_mon_probe_ack(self, conn, msg) -> None:
-        self.quorum.add(msg.data["rank"])
+        pass
 
     # -- osd lifecycle ------------------------------------------------------
     async def _h_osd_boot(self, conn, msg) -> None:
-        """OSD announces itself: {uuid, addr, host, osd_id?}."""
+        """OSD announces itself: {uuid, addr, host, osd_id?}.
+
+        Identity (uuid->id) and topology (id->host) come from the
+        replicated MAP, so any elected leader resolves reboots
+        identically -- never from a single mon's in-memory registry."""
         uuid = msg.data["uuid"]
         host = msg.data.get("host", "host0")
         addr = msg.data["addr"]
         osd_id = msg.data.get("osd_id")
         if osd_id is None:
-            osd_id = self.osd_uuids.get(uuid)
+            for o, info in self.osdmap.osds.items():
+                if info.uuid == uuid:
+                    osd_id = o
+                    break
         if osd_id is None:
             osd_id = self.osdmap.max_osd
-        self.osd_uuids[uuid] = osd_id
-        self.osd_hosts[osd_id] = host
         inc = Incremental(epoch=0)
         inc.new_up[osd_id] = list(addr)
         inc.new_in.append(osd_id)
         inc.new_weights[osd_id] = 0x10000
+        inc.new_uuids[osd_id] = uuid
+        inc.new_hosts[osd_id] = host
         inc.new_max_osd = max(self.osdmap.max_osd, osd_id + 1)
         inc.new_crush = self._build_crush_dict(extra_osd=(osd_id, host))
         await self.propose(inc)
-        await conn.send(Message("osd_boot_ack",
-                                {"osd_id": osd_id,
-                                 "epoch": self.osdmap.epoch}))
+        await conn.send(Message(
+            "osd_boot_ack",
+            {"osd_id": osd_id, "epoch": self.osdmap.epoch,
+             "monmap": [list(a) for a in self.peer_addrs
+                        if a is not None]}))
 
     def _build_crush_dict(self, extra_osd=None) -> dict:
         """Rebuild the CRUSH map from the osd->host registry.
@@ -292,8 +579,9 @@ class Monitor:
         register.
         """
         hosts: dict[str, list[int]] = defaultdict(list)
-        for osd, host in self.osd_hosts.items():
-            hosts[host].append(osd)
+        for osd, info in self.osdmap.osds.items():
+            if info.host:
+                hosts[info.host].append(osd)
         if extra_osd is not None:
             osd, host = extra_osd
             if osd not in hosts[host]:
@@ -321,7 +609,13 @@ class Monitor:
     async def _h_osd_failure(self, conn, msg) -> None:
         """Failure report; mark down once enough distinct reporters agree."""
         target = msg.data["target"]
-        reporter = msg.from_name
+        reporter = msg.data.get("reporter") or msg.from_name
+        if not self.is_leader:
+            if self.leader is not None:
+                await self._send_mon(self.leader, Message(
+                    "osd_failure", {"target": target,
+                                    "reporter": reporter}))
+            return
         if not self.osdmap.is_up(target):
             return
         self.failure_reports[target].add(reporter)
@@ -344,6 +638,8 @@ class Monitor:
         """An OSD requests an acting-set override for a pg (MOSDPGTemp:
         the gapped CRUSH primary hands serving to a complete peer while
         it backfills; an empty list clears the override)."""
+        if not self.is_leader:
+            return                  # the OSD's mon failover finds the leader
         pgid = msg.data["pgid"]
         osds = [int(o) for o in msg.data.get("osds", [])]
         if self.osdmap.pg_temp.get(pgid, []) != osds:
@@ -375,6 +671,13 @@ class Monitor:
     async def _h_mon_command(self, conn, msg) -> None:
         cmd = msg.data.get("cmd", "")
         args = msg.data.get("args", {})
+        if not self.is_leader and not msg.data.get("fwd"):
+            # peon: relay mutating traffic to the leader (the reference
+            # forwards with MForward); the reply routes back here
+            data = await self._forward_to_leader(msg)
+            data["tid"] = msg.data.get("tid")
+            await conn.send(Message("mon_command_reply", data))
+            return
         try:
             result = await self.handle_command(cmd, args)
             await conn.send(Message("mon_command_reply",
@@ -384,6 +687,31 @@ class Monitor:
             await conn.send(Message("mon_command_reply",
                                     {"ok": False, "error": str(e),
                                      "tid": msg.data.get("tid")}))
+
+    async def _forward_to_leader(self, msg) -> dict:
+        if self.leader is None or self.peer_addrs[self.leader] is None:
+            return {"ok": False, "error": "no quorum leader"}
+        relay_tid = f"fwd-{self.rank}-{time.monotonic_ns()}"
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._fwd_waiters = getattr(self, "_fwd_waiters", {})
+        self._fwd_waiters[relay_tid] = fut
+        try:
+            await self._send_mon(self.leader, Message(
+                "mon_command", {"cmd": msg.data.get("cmd", ""),
+                                "args": msg.data.get("args", {}),
+                                "tid": relay_tid, "fwd": True}))
+            return await asyncio.wait_for(fut, 10)
+        except asyncio.TimeoutError:
+            return {"ok": False, "error": "leader did not answer"}
+        finally:
+            self._fwd_waiters.pop(relay_tid, None)
+
+    async def _h_mon_command_reply(self, conn, msg) -> None:
+        fut = getattr(self, "_fwd_waiters", {}).pop(
+            msg.data.get("tid"), None)
+        if fut is not None and not fut.done():
+            fut.set_result({k: v for k, v in msg.data.items()
+                            if k != "tid"})
 
     async def handle_command(self, cmd: str, args: dict):
         if cmd == "osd pool create":
@@ -447,12 +775,11 @@ class Monitor:
             res = balance(self.osdmap, max_moves=int(args.get("max", 10)))
             plans = res["plans"]
             if plans:
+                from ..mgr.balancer import compact_items
                 inc = Incremental(epoch=0)
                 for pgid, items in plans.items():
-                    existing = [list(i) for i in
-                                self.osdmap.pg_upmap_items.get(pgid, [])]
-                    inc.new_pg_upmap_items[pgid] = existing + [
-                        list(i) for i in items]
+                    inc.new_pg_upmap_items[pgid] = compact_items(
+                        self.osdmap.pg_upmap_items.get(pgid, []), items)
                 await self.propose(inc)
             return {"moved": len(plans), "before": res["before"],
                     "after": res["after"]}
@@ -525,8 +852,9 @@ class Monitor:
     def _cmd_osd_tree(self):
         tree = []
         hosts = defaultdict(list)
-        for osd, host in self.osd_hosts.items():
-            hosts[host].append(osd)
+        for osd, info in self.osdmap.osds.items():
+            if info.host:
+                hosts[info.host].append(osd)
         for host in sorted(hosts):
             tree.append({"type": "host", "name": host})
             for osd in sorted(hosts[host]):
@@ -541,20 +869,50 @@ class Monitor:
     async def _tick_loop(self) -> None:
         try:
             while True:
-                await asyncio.sleep(0.5)
+                # lease renewal must outpace lease expiry by a
+                # comfortable margin (the reference renews at lease/2)
+                await asyncio.sleep(min(0.5,
+                                        self.config["mon_lease"] / 3))
                 await self._tick()
         except asyncio.CancelledError:
             pass
 
     async def _tick(self) -> None:
         now = time.monotonic()
+        # -- election/lease upkeep ------------------------------------------
+        if self._n_mons() > 1:
+            if self.leader is None:
+                if now > getattr(self, "_defer_until", 0.0):
+                    self.start_election()
+            elif self.is_leader:
+                # renew the lease; two consecutive sub-majority rounds
+                # mean we lost the quorum: step down and re-elect
+                if len(self._lease_acks | {self.rank}) < self._majority() \
+                        and self._lease_round > 0:
+                    self._lease_misses += 1
+                    if self._lease_misses >= 2:
+                        self.leader = None
+                        self._lease_misses = 0
+                        self.start_election()
+                else:
+                    self._lease_misses = 0
+                self._lease_acks = set()
+                self._lease_round = getattr(self, "_lease_round", 0) + 1
+                for r in sorted(self.quorum - {self.rank}):
+                    await self._send_mon(r, Message(
+                        "mon_lease", {"epoch": self.election_epoch}))
+            else:
+                if now > self._lease_expire:
+                    # leader went quiet: elect
+                    self.leader = None
+                    self.start_election()
         interval = self.config["mon_osd_down_out_interval"]
         to_out = [osd for osd, t in self._down_since.items()
                   if now - t > interval
                   and self.osdmap.osds.get(osd)
                   and self.osdmap.osds[osd].in_cluster
                   and not self.osdmap.osds[osd].up]
-        if to_out:
+        if to_out and self.is_leader:
             inc = Incremental(epoch=0)
             inc.new_out.extend(to_out)
             for osd in to_out:

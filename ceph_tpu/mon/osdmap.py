@@ -85,6 +85,7 @@ class OsdInfo:
     weight: int = 0x10000          # reweight, 16.16
     addr: tuple[str, int] | None = None
     uuid: str = ""
+    host: str = ""
     down_at_epoch: int = 0
 
 
@@ -107,12 +108,19 @@ class Incremental:
     new_pg_temp: dict[str, list[int]] = field(default_factory=dict)
     new_pg_upmap_items: dict[str, list] = field(default_factory=dict)
     removed_pg_upmap_items: list[str] = field(default_factory=list)
+    # replicated identity/topology state: a NEW leader must be able to
+    # rebuild the crush hierarchy and keep osd ids stable from the MAP
+    # alone, not from the old leader's in-memory registries
+    new_uuids: dict[int, str] = field(default_factory=dict)
+    new_hosts: dict[int, str] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         d = asdict(self)
         d["new_up"] = {str(k): v for k, v in self.new_up.items()}
         d["new_weights"] = {str(k): v for k, v in self.new_weights.items()}
         d["new_pools"] = {str(k): v for k, v in self.new_pools.items()}
+        d["new_uuids"] = {str(k): v for k, v in self.new_uuids.items()}
+        d["new_hosts"] = {str(k): v for k, v in self.new_hosts.items()}
         return d
 
     @classmethod
@@ -135,6 +143,10 @@ class Incremental:
             new_pg_upmap_items=dict(d.get("new_pg_upmap_items", {})),
             removed_pg_upmap_items=list(
                 d.get("removed_pg_upmap_items", [])),
+            new_uuids={int(k): v
+                       for k, v in d.get("new_uuids", {}).items()},
+            new_hosts={int(k): v
+                       for k, v in d.get("new_hosts", {}).items()},
         )
 
 
@@ -304,6 +316,10 @@ class OSDMap:
                 self.osds[osd].in_cluster = False
         for osd, w in inc.new_weights.items():
             self.osds.setdefault(osd, OsdInfo()).weight = w
+        for osd, uuid in inc.new_uuids.items():
+            self.osds.setdefault(osd, OsdInfo()).uuid = uuid
+        for osd, host in inc.new_hosts.items():
+            self.osds.setdefault(osd, OsdInfo()).host = host
         for pid, pd in inc.new_pools.items():
             spec = PoolSpec(**pd)
             self.pools[pid] = spec
@@ -341,7 +357,7 @@ class OSDMap:
             "max_osd": self.max_osd,
             "osds": {str(o): {"up": i.up, "in": i.in_cluster,
                               "weight": i.weight, "addr": i.addr,
-                              "uuid": i.uuid,
+                              "uuid": i.uuid, "host": i.host,
                               "down_at": i.down_at_epoch}
                      for o, i in self.osds.items()},
             "pools": {str(p): asdict(s) for p, s in self.pools.items()},
@@ -361,7 +377,8 @@ class OSDMap:
             m.osds[int(o)] = OsdInfo(
                 up=i["up"], in_cluster=i["in"], weight=i["weight"],
                 addr=tuple(i["addr"]) if i.get("addr") else None,
-                uuid=i.get("uuid", ""), down_at_epoch=i.get("down_at", 0))
+                uuid=i.get("uuid", ""), host=i.get("host", ""),
+                down_at_epoch=i.get("down_at", 0))
         for p, s in d.get("pools", {}).items():
             spec = PoolSpec(**s)
             m.pools[int(p)] = spec

@@ -51,6 +51,7 @@ class OSD:
         self.secret = secret
         self.msgr: Messenger | None = None
         self.mon_addr: tuple[str, int] | None = None
+        self.monmap: list[list] = []
         self.osdmap = OSDMap()
         self.pgs: dict[str, PG] = {}
         self.sched = MClockScheduler()
@@ -94,6 +95,8 @@ class OSD:
                          else None},
             reply_type="osd_boot_ack")
         self.whoami = ack["osd_id"]
+        self.monmap = [list(a) for a in ack.get("monmap", [])] or \
+            [list(self.mon_addr)]
         self.msgr.name = f"osd.{self.whoami}"
         # subscribe to map deltas; mon replies with the full map
         full = await self._mon_request("sub_osdmap", {},
@@ -166,27 +169,69 @@ class OSD:
 
     async def _mon_request(self, mtype: str, data: dict,
                            reply_type: str, timeout: float = 10) -> dict:
+        """Mon RPC with monmap failover: a dead mon rotates the request
+        to the next one (the MonClient hunting behavior).  Peons either
+        answer (map reads) or forward to the leader."""
         q: asyncio.Queue = asyncio.Queue()
 
         async def d(conn, msg):
             if msg.type == reply_type:
                 await q.put(msg.data)
 
+        targets = self._mon_targets()
+        per_try = max(2.0, timeout / max(1, len(targets)))
         self.msgr.add_dispatcher(d)
         try:
-            await self.msgr.send(self.mon_addr, "mon.0",
-                                 Message(mtype, data))
-            return await asyncio.wait_for(q.get(), timeout)
+            last_err: Exception | None = None
+            for addr, rank in targets:
+                try:
+                    await self.msgr.send(addr, f"mon.{rank}",
+                                         Message(mtype, data))
+                    reply = await asyncio.wait_for(q.get(), per_try)
+                    self.mon_addr = addr        # stick with a live mon
+                    return reply
+                except (ConnectionError, OSError,
+                        asyncio.TimeoutError) as e:
+                    last_err = e
+            raise last_err or asyncio.TimeoutError(mtype)
         finally:
             self.msgr.dispatchers.remove(d)
+
+    def _mon_targets(self) -> list[tuple[tuple[str, int], int]]:
+        """(addr, rank) hunting order: the current mon first, then the
+        rest of the monmap."""
+        mons = [tuple(a) for a in (self.monmap or [self.mon_addr])]
+        if tuple(self.mon_addr) in mons:
+            i0 = mons.index(tuple(self.mon_addr))
+            mons = mons[i0:] + mons[:i0]
+        return [(addr,
+                 self.monmap.index(list(addr))
+                 if self.monmap and list(addr) in self.monmap else 0)
+                for addr in mons]
+
+    async def _mon_send_failover(self, msg: Message) -> None:
+        """Fire-and-forget to the mon cluster: a dead mon rotates the
+        send to the next monmap entry (and re-homes mon_addr)."""
+        for addr, rank in self._mon_targets():
+            try:
+                await asyncio.wait_for(
+                    self.msgr.send(addr, f"mon.{rank}", msg), 2.0)
+                self.mon_addr = addr
+                return
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                continue
 
     # -- map handling -------------------------------------------------------
     def _apply_full_map(self, map_dict: dict) -> None:
         self.osdmap = OSDMap.from_dict(map_dict)
+        self._last_map_time = time.monotonic()
         self._on_map_change()
 
     def _apply_incremental(self, inc_dict: dict) -> None:
         inc = Incremental.from_dict(inc_dict)
+        self._last_map_time = time.monotonic()
+        if inc.epoch <= self.osdmap.epoch:
+            return          # duplicate delivery (multi-mon subscriptions)
         if inc.epoch != self.osdmap.epoch + 1:
             asyncio.ensure_future(self._catch_up_maps())
             return
@@ -412,6 +457,13 @@ class OSD:
     async def _heartbeat_once(self) -> None:
         now = time.monotonic()
         grace = self.config["osd_heartbeat_grace"]
+        # map-feed freshness: our subscribed mon may have died -- a
+        # quiet feed re-subscribes through the failover path (MonClient
+        # re-hunts on session loss the same way)
+        if now - getattr(self, "_last_map_time", now) > 5.0:
+            self._last_map_time = now          # one probe per window
+            t = asyncio.ensure_future(self._catch_up_maps())
+            self._tasks.append(t)
         # opportunistic re-kicks: a recovery push/pull that raced a peer
         # reboot backs off (the tick restarts it); a peering task that
         # died leaves the PG stranded (the tick re-runs it)
@@ -438,12 +490,8 @@ class OSD:
                 last = self._hb_last.get(osd, now)
                 if now - last <= grace:
                     continue
-                try:
-                    await self.msgr.send(
-                        self.mon_addr, "mon.0",
-                        Message("osd_failure", {"target": osd}))
-                except (ConnectionError, OSError):
-                    pass
+                await self._mon_send_failover(
+                    Message("osd_failure", {"target": osd}))
 
     # -- dispatch -----------------------------------------------------------
     async def _dispatch(self, conn, msg: Message) -> None:

@@ -35,6 +35,7 @@ class Objecter:
         self._waiters: dict[int, asyncio.Future] = {}
         self._cmd_waiters: dict[int, asyncio.Future] = {}
         self._refresh_tasks: set[asyncio.Task] = set()
+        self._watches: dict[tuple, object] = {}
         self.msgr.add_dispatcher(self._dispatch)
 
     # -- lifecycle ----------------------------------------------------------
@@ -66,8 +67,51 @@ class Objecter:
         finally:
             self.msgr.dispatchers.remove(d)
 
+    # -- watch/notify (linger ops) ------------------------------------------
+    def register_watch(self, pool_id: int, oid: str, cookie: int,
+                       callback) -> None:
+        """Track a watch; it re-registers itself after every map change
+        (the linger-op resend that keeps watches alive across primary
+        moves, Objecter::linger_watch)."""
+        self._watches[(pool_id, oid, cookie)] = callback
+
+    def unregister_watch(self, pool_id: int, oid: str,
+                         cookie: int) -> None:
+        self._watches.pop((pool_id, oid, cookie), None)
+
+    async def _rewatch_all(self) -> None:
+        for (pool_id, oid, cookie) in list(self._watches):
+            try:
+                await self.op_submit(pool_id, oid,
+                                     [{"op": "watch", "cookie": cookie}],
+                                     timeout=10)
+            except ObjecterError:
+                pass                 # retried on the next map change
+
+    async def _handle_watch_notify(self, conn, msg: Message) -> None:
+        payload = msg.segments[0] if msg.segments else b""
+        for (pool_id, oid, cookie), cb in list(self._watches.items()):
+            if pool_id == msg.data.get("pool") \
+                    and oid == msg.data.get("oid") \
+                    and cookie == msg.data.get("cookie"):
+                try:
+                    res = cb(payload)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    pass
+        try:
+            await conn.send(Message(
+                "watch_notify_ack",
+                {"notify_id": msg.data.get("notify_id")}))
+        except (ConnectionError, OSError):
+            pass
+
     # -- dispatch -----------------------------------------------------------
     async def _dispatch(self, conn, msg: Message) -> None:
+        if msg.type == "watch_notify":
+            await self._handle_watch_notify(conn, msg)
+            return
         if msg.type == "osd_op_reply":
             fut = self._waiters.pop(msg.data.get("tid"), None)
             if fut is not None and not fut.done():
@@ -76,6 +120,10 @@ class Objecter:
             inc = Incremental.from_dict(msg.data["inc"])
             if inc.epoch == self.osdmap.epoch + 1:
                 self.osdmap.apply_incremental(inc)
+                if self._watches:
+                    t = asyncio.ensure_future(self._rewatch_all())
+                    self._refresh_tasks.add(t)
+                    t.add_done_callback(self._refresh_tasks.discard)
             elif inc.epoch > self.osdmap.epoch:
                 t = asyncio.ensure_future(self._guarded_refresh())
                 self._refresh_tasks.add(t)
@@ -88,6 +136,8 @@ class Objecter:
     async def _guarded_refresh(self) -> None:
         try:
             await self._refresh_map(timeout=5)
+            if self._watches:
+                await self._rewatch_all()
         except (asyncio.TimeoutError, ConnectionError, OSError):
             pass     # next op's retry path refreshes again
 
@@ -108,7 +158,8 @@ class Objecter:
     async def op_submit(self, pool_id: int, oid: str, ops: list[dict],
                         nspace: str = "", timeout: float = 30,
                         attempt_timeout: float = 5,
-                        ps: int | None = None) -> Message:
+                        ps: int | None = None,
+                        extra: dict | None = None) -> Message:
         """Run ops on the object's primary, retrying through map churn."""
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout
@@ -136,7 +187,8 @@ class Objecter:
                     tuple(info.addr), f"osd.{primary}",
                     Message("osd_op", {"pgid": pgid, "oid": oid,
                                        "ops": meta, "tid": tid,
-                                       "reqid": reqid},
+                                       "reqid": reqid,
+                                       **(extra or {})},
                             segments=segs))
                 reply = await asyncio.wait_for(
                     fut, min(attempt_timeout, deadline - loop.time()))

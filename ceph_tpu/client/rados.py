@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 
 from .objecter import Objecter, ObjecterError
+from ..osd.pg import WRITE_OPS as _WRITE_OPS   # ops carrying the snapc
 
 
 class RadosError(Exception):
@@ -30,9 +31,16 @@ class Rados:
     """Cluster handle (librados ``rados_t`` analog)."""
 
     def __init__(self, mon_addr: tuple[str, int],
-                 name: str = "client.admin",
+                 name: str | None = None,
                  secret: bytes | None = None) -> None:
         self.mon_addr = tuple(mon_addr)
+        if name is None:
+            # entity names must be unique per client instance: two
+            # messengers sharing a name evict each other's connections
+            # at the peer (the reference gets unique client.<gid> ids
+            # from the mon's auth handshake)
+            import os
+            name = f"client.{os.urandom(4).hex()}"
         self.objecter = Objecter(name=name, secret=secret)
         self.connected = False
 
@@ -95,10 +103,39 @@ class IoCtx:
     def set_namespace(self, nspace: str) -> None:
         self.nspace = nspace
 
-    async def _op(self, oid: str, ops: list[dict]) -> tuple[dict, list]:
+    # -- self-managed snapshots (librados selfmanaged_snap_* API) -----------
+    def set_snap_context(self, seq: int, snaps: list[int]) -> None:
+        """Snap context stamped on subsequent writes (newest first)."""
+        self._snapc = {"seq": int(seq),
+                       "snaps": sorted((int(s) for s in snaps),
+                                       reverse=True)}
+
+    async def selfmanaged_snap_create(self) -> int:
+        """Allocate a snap id from the mon and fold it into the io
+        context (rados_ioctx_selfmanaged_snap_create)."""
+        sid = await self.rados.mon_command(
+            "osd pool selfmanaged-snap create", {"pool": self.pool_name})
+        old = getattr(self, "_snapc", {"seq": 0, "snaps": []})
+        self.set_snap_context(sid, [sid] + list(old["snaps"]))
+        return sid
+
+    async def selfmanaged_snap_remove(self, snapid: int) -> None:
+        await self.rados.mon_command(
+            "osd pool selfmanaged-snap rm",
+            {"pool": self.pool_name, "snap": int(snapid)})
+        old = getattr(self, "_snapc", {"seq": 0, "snaps": []})
+        self.set_snap_context(
+            old["seq"], [s for s in old["snaps"] if s != int(snapid)])
+
+    async def _op(self, oid: str, ops: list[dict],
+                  extra: dict | None = None) -> tuple[dict, list]:
+        snapc = getattr(self, "_snapc", None)
+        if snapc and any(o["op"] in _WRITE_OPS for o in ops):
+            extra = {**(extra or {}), "snapc": snapc}
         try:
             reply = await self.objecter.op_submit(self.pool_id, oid, ops,
-                                                  nspace=self.nspace)
+                                                  nspace=self.nspace,
+                                                  extra=extra)
         except ObjecterError as e:
             raise RadosError("ETIMEDOUT", str(e)) from e
         if "err" in reply.data:
@@ -117,11 +154,44 @@ class IoCtx:
         await self._op(oid, [{"op": "append", "data": data}])
 
     async def read(self, oid: str, length: int | None = None,
-                   offset: int = 0) -> bytes:
+                   offset: int = 0, snap: int | None = None) -> bytes:
+        extra = {"snapid": int(snap)} if snap else None
         data, segs = await self._op(oid, [{"op": "read", "off": offset,
-                                           "len": length}])
+                                           "len": length}], extra=extra)
         r = _check(data["results"])
         return segs[r["seg"]] if "seg" in r else b""
+
+    async def list_snaps(self, oid: str) -> dict:
+        data, _ = await self._op(oid, [{"op": "list_snaps"}])
+        return _check(data["results"])["snapset"]
+
+    # -- watch/notify (rados_watch3/rados_notify2) --------------------------
+    async def watch(self, oid: str, callback) -> int:
+        """Register a watch; ``callback(payload: bytes)`` fires on every
+        notify.  Survives primary moves via the objecter's linger
+        resend.  Returns the watch cookie."""
+        # cookies must be unique across every ioctx of this client: the
+        # PG keys watchers by (client entity, cookie)
+        cookie = next(self.objecter._tid)
+        await self._op(oid, [{"op": "watch", "cookie": cookie}])
+        self.objecter.register_watch(self.pool_id, oid, cookie, callback)
+        return cookie
+
+    async def unwatch(self, oid: str, cookie: int) -> None:
+        self.objecter.unregister_watch(self.pool_id, oid, cookie)
+        await self._op(oid, [{"op": "unwatch", "cookie": cookie}])
+
+    async def notify(self, oid: str, payload: bytes = b"",
+                     timeout: float = 5.0) -> dict:
+        """Send ``payload`` to every watcher; returns {acks, timeouts}
+        after all watchers answered or the timeout lapsed."""
+        data, _ = await self._op(oid, [
+            {"op": "notify", "data": payload, "timeout": timeout}])
+        return _check(data["results"])
+
+    async def list_watchers(self, oid: str) -> list:
+        data, _ = await self._op(oid, [{"op": "list_watchers"}])
+        return _check(data["results"])["watchers"]
 
     async def remove(self, oid: str) -> None:
         await self._op(oid, [{"op": "remove"}])

@@ -755,6 +755,30 @@ class Monitor:
             inc.new_weights[int(args["osd_id"])] = int(args["weight"])
             await self.propose(inc)
             return True
+        if cmd == "osd pool selfmanaged-snap create":
+            # serialize allocation: two concurrent creates reading the
+            # same snap_seq would hand out one id twice
+            if not hasattr(self, "_snap_alloc_lock"):
+                self._snap_alloc_lock = asyncio.Lock()
+            async with self._snap_alloc_lock:
+                pool = self.osdmap.get_pool_by_name(args["pool"])
+                if pool is None:
+                    raise ValueError(f"no pool {args['pool']}")
+                snapid = pool.snap_seq + 1
+                inc = Incremental(epoch=0)
+                inc.new_pool_snaps[pool.pool_id] = {"snap_seq": snapid}
+                await self.propose(inc)
+            return snapid
+        if cmd == "osd pool selfmanaged-snap rm":
+            pool = self.osdmap.get_pool_by_name(args["pool"])
+            if pool is None:
+                raise ValueError(f"no pool {args['pool']}")
+            sid = int(args["snap"])
+            inc = Incremental(epoch=0)
+            inc.new_pool_snaps[pool.pool_id] = {
+                "snap_seq": pool.snap_seq, "removed": [sid]}
+            await self.propose(inc)
+            return sid
         if cmd == "osd pg-upmap-items":
             pgid = args["pgid"]
             items = [[int(a), int(b)] for a, b in args["mappings"]]

@@ -44,6 +44,11 @@ class PoolSpec:
     crush_rule: int = 0
     erasure_code_profile: str = ""
     flags: int = 1  # FLAG_HASHPSPOOL
+    # self-managed snapshots (pg_pool_t::snap_seq / removed_snaps):
+    # snap ids are allocated monotonically by the mon; removal marks
+    # the id for OSD-side trimming
+    snap_seq: int = 0
+    removed_snaps: list = field(default_factory=list)
 
     @property
     def pg_num_mask(self) -> int:
@@ -113,6 +118,8 @@ class Incremental:
     # alone, not from the old leader's in-memory registries
     new_uuids: dict[int, str] = field(default_factory=dict)
     new_hosts: dict[int, str] = field(default_factory=dict)
+    # pool_id -> {"snap_seq": int, "removed": [snapids]}
+    new_pool_snaps: dict[int, dict] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         d = asdict(self)
@@ -121,6 +128,8 @@ class Incremental:
         d["new_pools"] = {str(k): v for k, v in self.new_pools.items()}
         d["new_uuids"] = {str(k): v for k, v in self.new_uuids.items()}
         d["new_hosts"] = {str(k): v for k, v in self.new_hosts.items()}
+        d["new_pool_snaps"] = {str(k): v
+                               for k, v in self.new_pool_snaps.items()}
         return d
 
     @classmethod
@@ -147,6 +156,8 @@ class Incremental:
                        for k, v in d.get("new_uuids", {}).items()},
             new_hosts={int(k): v
                        for k, v in d.get("new_hosts", {}).items()},
+            new_pool_snaps={int(k): v for k, v in
+                            d.get("new_pool_snaps", {}).items()},
         )
 
 
@@ -345,6 +356,14 @@ class OSDMap:
                 self.pg_temp[pgid] = list(osds)
             else:
                 self.pg_temp.pop(pgid, None)
+        for pid, snaps in inc.new_pool_snaps.items():
+            pool = self.pools.get(pid)
+            if pool is not None:
+                pool.snap_seq = max(pool.snap_seq,
+                                    int(snaps.get("snap_seq", 0)))
+                for sid in snaps.get("removed", []):
+                    if sid not in pool.removed_snaps:
+                        pool.removed_snaps.append(sid)
         for pgid, items in inc.new_pg_upmap_items.items():
             self.pg_upmap_items[pgid] = [tuple(i) for i in items]
         for pgid in inc.removed_pg_upmap_items:

@@ -109,6 +109,26 @@ def apply_mutations(txn: Transaction, coll: str, oid: str,
             txn.omap_rmkeys(coll, oid, m["keys"])
         elif op == "omap_clear":
             txn.omap_clear(coll, oid)
+        # -- snapshot machinery (ceph_tpu/osd/snaps.py): these ride in
+        # the same entry as the data op so replicas stay in lockstep
+        elif op == "clone_from":
+            # clone-on-write: oid here is the CLONE object; src is the
+            # head whose current state it freezes
+            from .snaps import SNAPMAPPER_OID, snapmapper_key
+            txn.clone(coll, m["src"], oid)
+            txn.omap_setkeys(coll, SNAPMAPPER_OID,
+                             {snapmapper_key(s, m["src"]): b""
+                              for s in m.get("snaps", [])})
+        elif op == "snapset_set":
+            from .snaps import SNAPSETS_OID
+            txn.touch(coll, SNAPSETS_OID)
+            value = m["value"]
+            if isinstance(value, str):
+                value = value.encode()
+            txn.omap_setkeys(coll, SNAPSETS_OID, {m["head"]: value})
+        elif op == "snapmap_rm":
+            from .snaps import SNAPMAPPER_OID
+            txn.omap_rmkeys(coll, SNAPMAPPER_OID, m["keys"])
         else:
             raise ValueError(f"unknown mutation op {op}")
 

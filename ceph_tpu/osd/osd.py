@@ -73,6 +73,8 @@ class OSD:
         # observability (src/common/perf_counters + TrackedOp analog)
         self.perf = PerfCountersCollection()
         self.perf_osd = self.perf.create("osd")
+        self._notify_serial = itertools.count(1)
+        self._notify_waiters: dict[str, asyncio.Future] = {}
         self._inflight: dict[int, dict] = {}
         self._op_serial = itertools.count(1)
         self.admin_socket: AdminSocket | None = None
@@ -163,6 +165,8 @@ class OSD:
                 pg._recovery_task.cancel()
             if pg._peering_task:
                 pg._peering_task.cancel()
+            if pg._snap_trim_task:
+                pg._snap_trim_task.cancel()
         if self.msgr:
             await self.msgr.shutdown()
         self.store.umount()
@@ -265,6 +269,9 @@ class OSD:
                         continue
                     pg = PG(self, pgid, pool, profile)
                     self.pgs[pgid] = pg
+                # a full-map catch-up builds NEW PoolSpec objects: the
+                # pg must track the live one (removed_snaps et al)
+                pg.pool = pool
                 changed = pg.update_mapping(up, acting, epoch)
                 if changed and pg.is_primary():
                     pg.kick_peering()
@@ -474,6 +481,8 @@ class OSD:
                 pg.kick_recovery()
             elif pg.state == "peering":
                 pg.kick_peering()
+            if pg.state == "active" and pg.pool.removed_snaps:
+                pg.kick_snap_trim(pg.pool.removed_snaps)
         peers = [osd for osd, info in self.osdmap.osds.items()
                  if osd != self.whoami and info.up]
         await asyncio.gather(*(self._ping_one(o, now) for o in peers),
@@ -511,6 +520,11 @@ class OSD:
                                 {"from_osd": self.whoami,
                                  "stamp": msg.data["stamp"]}))
 
+    async def _h_watch_notify_ack(self, conn, msg) -> None:
+        fut = self._notify_waiters.pop(msg.data.get("notify_id"), None)
+        if fut is not None and not fut.done():
+            fut.set_result(msg.data)
+
     async def _h_osd_ping_reply(self, conn, msg) -> None:
         self._hb_last[msg.data["from_osd"]] = time.monotonic()
 
@@ -530,7 +544,7 @@ class OSD:
             "type": "+".join(op_names), "start": time.monotonic()}
         try:
             with self.perf_osd.time("op_latency"):
-                data, segments = await pg.do_op(msg)
+                data, segments = await pg.do_op(msg, conn)
         finally:
             self._inflight.pop(opid, None)
         if "err" not in data:          # rejected ops aren't throughput

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 
+from ..msg import Message
 from ..os.transaction import Transaction
 from .backend import (
     HIDDEN_XATTRS, META_OID, ReplicatedBackend, apply_mutations,
@@ -33,6 +34,7 @@ WRITE_OPS = {"create", "write", "writefull", "append", "truncate", "zero",
              "remove", "setxattr", "rmxattr", "omap_set", "omap_rm",
              "omap_clear"}
 READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get", "list"}
+WATCH_OPS = {"watch", "unwatch", "notify", "list_watchers", "list_snaps"}
 
 
 class PG:
@@ -64,6 +66,12 @@ class PG:
         self._recovery_task: asyncio.Task | None = None
         self._peering_task: asyncio.Task | None = None
         self._completed_reqids: dict[tuple[str, int], EVersion] = {}
+        # watch/notify (Watch.cc): oid -> {(client, cookie): conn};
+        # in-memory on the primary -- clients re-watch on map change
+        # (the Objecter's linger resend)
+        self.watchers: dict[str, dict[tuple, object]] = {}
+        self.trimmed_snaps: set[int] = set()
+        self._snap_trim_task: asyncio.Task | None = None
         if not self.osd.store.collection_exists(self.coll):
             txn = Transaction()
             txn.create_collection(self.coll)
@@ -85,6 +93,8 @@ class PG:
         if "past_intervals" in omap:
             self.past_intervals = PastIntervals.from_dict(
                 json.loads(omap["past_intervals"]))
+        if "trimmed_snaps" in omap:
+            self.trimmed_snaps = set(json.loads(omap["trimmed_snaps"]))
 
     def _meta_kv(self) -> dict[str, bytes]:
         return {
@@ -93,6 +103,8 @@ class PG:
             "missing": json.dumps(self.missing.to_dict()).encode(),
             "past_intervals": json.dumps(
                 self.past_intervals.to_dict()).encode(),
+            "trimmed_snaps": json.dumps(
+                sorted(self.trimmed_snaps)).encode(),
         }
 
     def persist_meta(self, txn: Transaction | None = None) -> None:
@@ -169,6 +181,10 @@ class PG:
         if self._peering_task:
             self._peering_task.cancel()
             self._peering_task = None
+        if self._snap_trim_task:
+            self._snap_trim_task.cancel()
+            self._snap_trim_task = None
+        self.watchers.clear()     # clients re-watch on the new interval
         return True
 
     # -- peering (primary drives GetInfo -> GetLog -> Activate) -------------
@@ -331,12 +347,17 @@ class PG:
             # target finished under a previous interval) clears here
             self._maybe_clear_pg_temp()
 
+    def _internal_oid(self, oid: str) -> bool:
+        from .snaps import INTERNAL_OIDS, is_clone
+        return oid == META_OID or oid in INTERNAL_OIDS or is_clone(oid)
+
     def object_vers(self) -> dict[str, tuple[int, int]]:
         """oid -> stored version stamp for every object in this PG."""
         from .backend import VER_XATTR, ver_decode
+        from .snaps import INTERNAL_OIDS
         out: dict[str, tuple[int, int]] = {}
         for oid in self.osd.store.list_objects(self.coll):
-            if oid == META_OID:
+            if oid == META_OID or oid in INTERNAL_OIDS:
                 continue
             out[oid] = ver_decode(
                 self.osd.store.getattr(self.coll, oid, VER_XATTR))
@@ -349,8 +370,10 @@ class PG:
         backfill working sets O(limit) instead of O(PG)."""
         from .backend import VER_XATTR, ver_decode
         # +1 as the exhaustion probe; META_OID may occupy one slot
+        from .snaps import INTERNAL_OIDS
         names = [o for o in self.osd.store.list_objects_range(
-            self.coll, begin, limit + 2) if o != META_OID]
+            self.coll, begin, limit + 2)
+            if o != META_OID and o not in INTERNAL_OIDS]
         batch = names[:limit]
         out = {oid: ver_decode(
             self.osd.store.getattr(self.coll, oid, VER_XATTR))
@@ -469,11 +492,13 @@ class PG:
             self.osd.store.queue_transaction(txn)
 
     # -- client op execution (primary) --------------------------------------
-    async def do_op(self, msg) -> tuple[dict, list[bytes]]:
+    async def do_op(self, msg, conn=None) -> tuple[dict, list[bytes]]:
         ops = unpack_mutations(msg.data["ops"], msg.segments)
         oid = msg.data["oid"]
         rq = msg.data.get("reqid")
         reqid = (rq[0], rq[1]) if rq else None
+        snapc = msg.data.get("snapc")
+        snapid = msg.data.get("snapid")
         async with self.lock:
             if self.state != "active" or not self.is_primary():
                 return ({"err": "ENOTPRIMARY", "state": self.state}, [])
@@ -499,6 +524,18 @@ class PG:
             # the vector through one ObjectContext): reads that follow
             # writes observe the accumulated pending state via an
             # overlay snapshot; all writes commit atomically at the end
+            # snap reads resolve through the SnapSet to the clone that
+            # froze the content live at that snap
+            read_oid = oid
+            if snapid:
+                from .snaps import clone_oid, load_snapset, resolve_read
+                ss = load_snapset(self.osd.store, self.coll, oid)
+                target = resolve_read(ss, int(snapid))
+                if target is None:
+                    return ({"results": [{"err": "ENOENT"}
+                                         for _ in ops]}, [])
+                if target:
+                    read_oid = clone_oid(oid, target)
             results: list[dict] = []
             segments: list[bytes] = []
             writes: list[dict] = []
@@ -515,22 +552,35 @@ class PG:
                             applied = len(writes)
                         r, seg = self._read_overlay_op(overlay, oid, op)
                     else:
-                        r, seg = await self._do_read_op(oid, op)
+                        r, seg = await self._do_read_op(read_oid, op)
                     if seg is not None:
                         r["seg"] = len(segments)
                         segments.append(seg)
                     results.append(r)
                 elif name in WRITE_OPS:
-                    writes.append(op)
-                    results.append({"ok": True})
+                    if snapid:
+                        results.append({"err": "EROFS snap read context"})
+                    else:
+                        writes.append(op)
+                        results.append({"ok": True})
+                elif name in WATCH_OPS:
+                    r = await self._do_watch_op(oid, op, msg, conn)
+                    results.append(r)
                 else:
                     results.append({"err": f"EOPNOTSUPP {name}"})
             if writes:
-                err = await self._do_writes(oid, writes, reqid)
+                err = await self._do_writes(oid, writes, reqid,
+                                            snapc=snapc)
                 if err:
                     return ({"err": err}, [])
-            return ({"results": results,
-                     "version": self.info.last_update.to_list()}, segments)
+            ret = ({"results": results,
+                    "version": self.info.last_update.to_list()}, segments)
+        # notify ack-waits run OUTSIDE the PG lock (see _do_watch_op)
+        for r in results:
+            wait = r.pop("__wait", None)
+            if wait is not None:
+                await wait()
+        return ret
 
     # -- pending-write overlay (in-order read-after-write) -------------------
     async def _make_overlay(self, oid: str) -> dict:
@@ -600,7 +650,7 @@ class PG:
         name = op["op"]
         if name == "list":
             oids = {o for o in self.osd.store.list_objects(self.coll)
-                    if o != META_OID}
+                    if not self._internal_oid(o)}
             (oids.add if ov["exists"] else oids.discard)(oid)
             return {"ok": True, "oids": sorted(oids)}, None
         if name == "stat":
@@ -640,7 +690,7 @@ class PG:
              and await self.backend.object_size(oid) > 0)
         if name == "list":
             oids = [o for o in self.osd.store.list_objects(self.coll)
-                    if o != META_OID]
+                    if not self._internal_oid(o)]
             return {"ok": True, "oids": sorted(oids)}, None
         if not exists and name != "stat":
             return {"err": "ENOENT"}, None
@@ -670,12 +720,186 @@ class PG:
                     "omap": {k: v.hex() for k, v in omap.items()}}, None
         return {"err": f"EOPNOTSUPP {name}"}, None
 
+    # -- watch/notify (Watch.cc) ---------------------------------------------
+    async def _do_watch_op(self, oid: str, op: dict, msg,
+                           conn) -> dict:
+        name = op["op"]
+        client = msg.from_name or "?"
+        cookie = int(op.get("cookie", 0))
+        if name == "watch":
+            if conn is None:
+                return {"err": "EINVAL watch needs a connection"}
+            self.watchers.setdefault(oid, {})[(client, cookie)] = conn
+            return {"ok": True, "watchers": len(self.watchers[oid])}
+        if name == "unwatch":
+            self.watchers.get(oid, {}).pop((client, cookie), None)
+            return {"ok": True}
+        if name == "list_watchers":
+            live = {k: c for k, c in self.watchers.get(oid, {}).items()
+                    if not getattr(c, "closed", False)}
+            self.watchers[oid] = live
+            return {"ok": True,
+                    "watchers": [[cl, ck] for cl, ck in live]}
+        if name == "list_snaps":
+            from .snaps import load_snapset
+            ss = load_snapset(self.osd.store, self.coll, oid)
+            return {"ok": True, "snapset": ss}
+        if name == "notify":
+            payload = bytes(op.get("data", b""))
+            timeout = float(op.get("timeout", 5.0))
+            targets = [(k, c) for k, c in
+                       self.watchers.get(oid, {}).items()
+                       if not getattr(c, "closed", False)]
+            acks: list[list] = []
+            missed: list[list] = []
+            waiting = []
+            for (cl, ck), wconn in targets:
+                nid = f"{self.pgid}:{oid}:{next(self.osd._notify_serial)}"
+                fut = asyncio.get_event_loop().create_future()
+                self.osd._notify_waiters[nid] = fut
+                try:
+                    await wconn.send(Message(
+                        "watch_notify",
+                        {"pool": self.pool.pool_id, "oid": oid,
+                         "notify_id": nid, "cookie": ck},
+                        segments=[payload]))
+                    waiting.append(([cl, ck], nid, fut))
+                except (ConnectionError, OSError):
+                    self.osd._notify_waiters.pop(nid, None)
+                    self.watchers.get(oid, {}).pop((cl, ck), None)
+                    missed.append([cl, ck])
+            # the ACK WAIT must not run under the PG lock: a watcher
+            # whose callback writes to this PG would deadlock until the
+            # timeout, and every client op would stall behind it.  The
+            # caller awaits this after releasing the lock.
+            result = {"ok": True, "acks": acks, "timeouts": missed}
+
+            async def wait_acks():
+                for who, nid, fut in waiting:
+                    try:
+                        await asyncio.wait_for(fut, timeout)
+                        acks.append(who)
+                    except asyncio.TimeoutError:
+                        missed.append(who)
+                    finally:
+                        self.osd._notify_waiters.pop(nid, None)
+            result["__wait"] = wait_acks
+            return result
+        return {"err": f"EOPNOTSUPP {name}"}
+
+    # -- snap trim (SnapMapper.h:339 reverse index -> purge clones) ----------
+    def kick_snap_trim(self, removed: list[int]) -> None:
+        pending = sorted(set(int(s) for s in removed)
+                         - self.trimmed_snaps)
+        if not pending or not self.is_primary() \
+                or self.state != "active":
+            return
+        if self._snap_trim_task is None or self._snap_trim_task.done():
+            self._snap_trim_task = asyncio.ensure_future(
+                self._snap_trim(pending))
+
+    async def _snap_trim(self, snaps: list[int]) -> None:
+        """Purge removed snaps: walk the SnapMapper rows, shrink clone
+        coverage, delete clones nobody references.  All mutations ride
+        normal log entries, so replicas trim in lockstep and recovery
+        replays interrupted trims."""
+        from .snaps import (
+            SNAPMAPPER_OID, clone_oid, load_snapset, snapmapper_key)
+        try:
+            for sid in snaps:
+                prefix = f"{sid:016x}/"
+                rows = [k for k in self.osd.store.omap_get(
+                    self.coll, SNAPMAPPER_OID) if k.startswith(prefix)]
+                for key in rows:
+                    head = key[len(prefix):]
+                    async with self.lock:
+                        if self.state != "active" \
+                                or not self.is_primary():
+                            return
+                        ss = load_snapset(self.osd.store, self.coll,
+                                          head)
+                        target = next((c for c in ss["clones"]
+                                       if sid in c[1]), None)
+                        muts = [{"op": "snapmap_rm", "keys": [key]}]
+                        entry_oid = head
+                        delete = False
+                        if target is not None:
+                            target[1].remove(sid)
+                            entry_oid = clone_oid(head, target[0])
+                            if not target[1]:
+                                ss["clones"].remove(target)
+                                muts.append({"op": "remove"})
+                                delete = True
+                        muts.append({"op": "snapset_set", "head": head,
+                                     "value": json.dumps(ss)})
+                        entry = LogEntry(
+                            op=DELETE if delete else MODIFY,
+                            oid=entry_oid,
+                            version=EVersion(
+                                self.osd.osdmap.epoch,
+                                self.info.last_update.version + 1),
+                            prior_version=ZERO, mutations=[],
+                            reqid=None)
+                        await self.backend.submit_transaction(entry,
+                                                              muts)
+                async with self.lock:
+                    self.trimmed_snaps.add(sid)
+                    self.persist_meta()
+        except asyncio.CancelledError:
+            pass
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass                    # re-kicked by the next tick
+
+    # -- snapshots (snaps.py; SnapMapper.h:339, make_writeable) --------------
+    async def _prepare_cow(self, oid: str, snapc: dict,
+                           size: int) -> list[dict] | str:
+        """Clone-on-write: the first mutation after a newer snap clones
+        the head so the snap keeps its frozen content.  Returns the
+        snapset-update mutations to ride with the write entry, or an
+        error string."""
+        from .backend import ReplicatedBackend
+        from .snaps import clone_oid, load_snapset
+        if not isinstance(self.backend, ReplicatedBackend):
+            return "EOPNOTSUPP snapshots on erasure pools"
+        ss = load_snapset(self.osd.store, self.coll, oid)
+        seq = int(snapc.get("seq", 0))
+        exists = self.osd.store.exists(self.coll, oid)
+        if exists and seq > ss["seq"]:
+            newly = sorted(int(s) for s in snapc.get("snaps", [])
+                           if int(s) > ss["seq"])
+            if newly:
+                cid = newly[-1]
+                centry = LogEntry(
+                    op=MODIFY, oid=clone_oid(oid, cid),
+                    version=EVersion(self.osd.osdmap.epoch,
+                                     self.info.last_update.version + 1),
+                    prior_version=ZERO, mutations=[], reqid=None)
+                await self.backend.submit_transaction(
+                    centry, [{"op": "clone_from", "src": oid,
+                              "snaps": newly}])
+                ss["clones"].append([cid, newly, size])
+        if not exists:
+            # created (or re-created after a delete) under this snap
+            # context: snaps <= seq predate this incarnation, so reads
+            # at them must not see the new head (deletion intervals)
+            ss["born"] = max(ss.get("born", 0), seq)
+        ss["seq"] = max(ss["seq"], seq)
+        return [{"op": "snapset_set", "head": oid,
+                 "value": json.dumps(ss)}]
+
     async def _do_writes(self, oid: str, ops: list[dict],
-                         reqid: tuple[str, int] | None = None) -> str | None:
+                         reqid: tuple[str, int] | None = None,
+                         snapc: dict | None = None) -> str | None:
         """Resolve logical ops to offset-explicit mutations, append a log
         entry, run the backend transaction."""
         await self.wait_for_backfill_pushes(oid)
         size = await self.backend.object_size(oid)
+        snap_muts: list[dict] = []
+        if snapc and snapc.get("snaps"):
+            got = await self._prepare_cow(oid, snapc, size)
+            if isinstance(got, str):
+                return got
+            snap_muts = got
         muts: list[dict] = []
         is_delete = False       # tracks the FINAL state: remove followed
         for op in ops:          # by a recreate is a MODIFY, not a DELETE
@@ -731,6 +955,7 @@ class PG:
                 muts.append({"op": "omap_rm", "keys": op["keys"]})
             elif name == "omap_clear":
                 muts.append({"op": "omap_clear"})
+        muts += snap_muts
         prior = self.log.last_version_of(oid) or ZERO
         entry = LogEntry(
             op=DELETE if is_delete else MODIFY, oid=oid,
@@ -941,6 +1166,13 @@ class PG:
             if not replies or replies[0].data.get("err"):
                 raise asyncio.TimeoutError(
                     f"backfill progress to osd.{peer} failed")
+        # the snap-index objects (snapsets/snapmapper omaps) mutate
+        # without version stamps, so the scan diff cannot see their
+        # divergence: push them unconditionally before declaring done
+        from .snaps import INTERNAL_OIDS
+        for ioid in sorted(INTERNAL_OIDS):
+            if self.osd.store.exists(self.coll, ioid):
+                await self._backfill_push(peer, ioid)
         replies = await self.osd.fanout_and_wait(
             [(peer, "pg_backfill_done", {"pgid": self.pgid}, [])],
             collect=True, timeout=10)

@@ -1,0 +1,204 @@
+"""Self-managed snapshots (clone-on-write, snap reads, trim) and
+watch/notify, through the librados-shaped client (SnapMapper.h:339,
+PrimaryLogPG::make_writeable, Watch.cc)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client import Rados, RadosError
+
+from test_client import make_cluster, teardown, run
+
+
+async def wait_for(cond, timeout=30.0, msg="condition"):
+    for _ in range(int(timeout / 0.2)):
+        if cond():
+            return
+        await asyncio.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def test_snapshot_cow_and_snap_reads():
+    async def main():
+        mon, osds = await make_cluster(3)
+        rados = await Rados(mon.msgr.addr).connect()
+        try:
+            await rados.pool_create("rbd", pg_num=4)
+            io = await rados.open_ioctx("rbd")
+            await io.write_full("obj", b"gen-one")
+            s1 = await io.selfmanaged_snap_create()
+            # unwritten since s1: snap read falls through to head
+            assert await io.read("obj", snap=s1) == b"gen-one"
+            await io.write_full("obj", b"gen-two!")   # triggers COW
+            assert await io.read("obj") == b"gen-two!"
+            assert await io.read("obj", snap=s1) == b"gen-one"
+            s2 = await io.selfmanaged_snap_create()
+            await io.write_full("obj", b"gen-three")
+            assert await io.read("obj") == b"gen-three"
+            assert await io.read("obj", snap=s2) == b"gen-two!"
+            assert await io.read("obj", snap=s1) == b"gen-one"
+            ss = await io.list_snaps("obj")
+            assert len(ss["clones"]) == 2
+            # multiple untouched snaps fold into ONE clone
+            s3 = await io.selfmanaged_snap_create()
+            s4 = await io.selfmanaged_snap_create()
+            await io.write_full("obj", b"gen-five!")
+            ss = await io.list_snaps("obj")
+            assert len(ss["clones"]) == 3
+            assert sorted(ss["clones"][-1][1]) == [s3, s4]
+            assert await io.read("obj", snap=s3) == b"gen-three"
+            assert await io.read("obj", snap=s4) == b"gen-three"
+            # object born after a snap: read at that snap is ENOENT
+            await io.write_full("newborn", b"baby")
+            with pytest.raises(RadosError):
+                await io.read("newborn", snap=s1)
+        finally:
+            await teardown(mon, osds, rados)
+    run(main())
+
+
+def test_snapshot_survives_head_delete():
+    async def main():
+        mon, osds = await make_cluster(3)
+        rados = await Rados(mon.msgr.addr).connect()
+        try:
+            await rados.pool_create("rbd", pg_num=4)
+            io = await rados.open_ioctx("rbd")
+            await io.write_full("doomed", b"keep-me")
+            s1 = await io.selfmanaged_snap_create()
+            await io.remove("doomed")                 # COW then delete
+            with pytest.raises(RadosError):
+                await io.read("doomed")
+            assert await io.read("doomed", snap=s1) == b"keep-me"
+            # recreate: head is new, snap still reads the old clone
+            await io.write_full("doomed", b"reborn")
+            assert await io.read("doomed") == b"reborn"
+            assert await io.read("doomed", snap=s1) == b"keep-me"
+        finally:
+            await teardown(mon, osds, rados)
+    run(main())
+
+
+def test_snap_trim_purges_clones():
+    async def main():
+        mon, osds = await make_cluster(3, osd_config={
+            "osd_heartbeat_interval": 0.2})
+        rados = await Rados(mon.msgr.addr).connect()
+        try:
+            await rados.pool_create("rbd", pg_num=1)
+            io = await rados.open_ioctx("rbd")
+            await io.write_full("t-obj", b"v1")
+            s1 = await io.selfmanaged_snap_create()
+            await io.write_full("t-obj", b"v2")
+            assert await io.read("t-obj", snap=s1) == b"v1"
+            await io.selfmanaged_snap_remove(s1)
+
+            from ceph_tpu.osd.snaps import is_clone
+            def clones_gone():
+                for o in osds:
+                    for pgid, pg in o.pgs.items():
+                        for oid in o.store.list_objects(pg.coll):
+                            if is_clone(oid):
+                                return False
+                return True
+            await wait_for(clones_gone, timeout=30,
+                           msg="clones purged on every replica")
+            # head unaffected
+            assert await io.read("t-obj") == b"v2"
+            ss = await io.list_snaps("t-obj")
+            assert ss["clones"] == []
+        finally:
+            await teardown(mon, osds, rados)
+    run(main())
+
+
+def test_snapshots_replicate_and_survive_failover():
+    async def main():
+        from ceph_tpu.osd import OSD
+        mon, osds = await make_cluster(3, osd_config={
+            "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 2.0})
+        rados = await Rados(mon.msgr.addr).connect()
+        try:
+            await rados.pool_create("rbd", pg_num=1)
+            io = await rados.open_ioctx("rbd")
+            await io.write_full("fo", b"alpha")
+            s1 = await io.selfmanaged_snap_create()
+            await io.write_full("fo", b"beta")
+            # kill the pg primary; snap read must survive via replicas
+            pool_id = mon.osdmap.pool_names["rbd"]
+            up, acting = mon.osdmap.pg_to_up_acting(pool_id, 0)
+            primary = acting[0]
+            victim = next(o for o in osds if o.whoami == primary)
+            await victim.stop()
+            osds.remove(victim)
+            await wait_for(lambda: not mon.osdmap.is_up(primary),
+                           msg="primary down")
+            await asyncio.sleep(1.0)
+            assert await io.read("fo", snap=s1) == b"alpha"
+            assert await io.read("fo") == b"beta"
+        finally:
+            await teardown(mon, osds, rados)
+    run(main())
+
+
+def test_watch_notify_roundtrip():
+    async def main():
+        mon, osds = await make_cluster(3)
+        r1 = await Rados(mon.msgr.addr).connect()
+        r2 = await Rados(mon.msgr.addr).connect()
+        try:
+            await r1.pool_create("rbd", pg_num=4)
+            io1 = await r1.open_ioctx("rbd")
+            io2 = await r2.open_ioctx("rbd")
+            await io1.write_full("hdr", b"header")
+            got = []
+            cookie = await io1.watch("hdr", lambda p: got.append(p))
+            watchers = await io2.list_watchers("hdr")
+            assert len(watchers) == 1
+            res = await io2.notify("hdr", b"invalidate!")
+            assert len(res["acks"]) == 1 and not res["timeouts"]
+            assert got == [b"invalidate!"]
+            # unwatch: notifies no longer reach us
+            await io1.unwatch("hdr", cookie)
+            res = await io2.notify("hdr", b"again")
+            assert res["acks"] == []
+            assert got == [b"invalidate!"]
+        finally:
+            await r2.shutdown()
+            await teardown(mon, osds, r1)
+    run(main())
+
+
+def test_watch_survives_primary_failover():
+    async def main():
+        mon, osds = await make_cluster(4, osd_config={
+            "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 2.0})
+        r1 = await Rados(mon.msgr.addr).connect()
+        r2 = await Rados(mon.msgr.addr).connect()
+        try:
+            await r1.pool_create("rbd", pg_num=1, size=3)
+            io1 = await r1.open_ioctx("rbd")
+            io2 = await r2.open_ioctx("rbd")
+            await io1.write_full("w-obj", b"x")
+            got = []
+            await io1.watch("w-obj", lambda p: got.append(p))
+            pool_id = mon.osdmap.pool_names["rbd"]
+            _, acting = mon.osdmap.pg_to_up_acting(pool_id, 0)
+            victim = next(o for o in osds if o.whoami == acting[0])
+            await victim.stop()
+            osds.remove(victim)
+            await wait_for(lambda: not mon.osdmap.is_up(victim.whoami),
+                           msg="old primary down")
+            # give the linger re-watch a moment on the new primary
+            await asyncio.sleep(2.0)
+            for _ in range(40):
+                res = await io2.notify("w-obj", b"ping")
+                if res["acks"]:
+                    break
+                await asyncio.sleep(0.5)
+            assert got and got[-1] == b"ping", got
+        finally:
+            await r2.shutdown()
+            await teardown(mon, osds, r1)
+    run(main())

@@ -69,33 +69,46 @@ class Objecter:
 
     # -- watch/notify (linger ops) ------------------------------------------
     def register_watch(self, pool_id: int, oid: str, cookie: int,
-                       callback) -> None:
-        """Track a watch; it re-registers itself after every map change
-        (the linger-op resend that keeps watches alive across primary
-        moves, Objecter::linger_watch)."""
-        self._watches[(pool_id, oid, cookie)] = callback
+                       callback, nspace: str = "") -> None:
+        """Track a watch; it re-registers itself whenever its PG's
+        primary moves (the linger-op resend, Objecter::linger_watch)."""
+        self._watches[(pool_id, oid, cookie)] = {
+            "cb": callback, "nspace": nspace,
+            "target": self.calc_target(pool_id, oid, nspace)}
 
     def unregister_watch(self, pool_id: int, oid: str,
                          cookie: int) -> None:
         self._watches.pop((pool_id, oid, cookie), None)
 
     async def _rewatch_all(self) -> None:
-        for (pool_id, oid, cookie) in list(self._watches):
+        """Re-register watches whose primary moved, concurrently --
+        unrelated map churn must not trigger K serial round trips."""
+        stale = []
+        for key, w in list(self._watches.items()):
+            pool_id, oid, cookie = key
+            target = self.calc_target(pool_id, oid, w["nspace"])
+            if target != w["target"]:
+                w["target"] = target
+                stale.append((pool_id, oid, cookie, w["nspace"]))
+
+        async def one(pool_id, oid, cookie, nspace):
             try:
                 await self.op_submit(pool_id, oid,
                                      [{"op": "watch", "cookie": cookie}],
-                                     timeout=10)
+                                     nspace=nspace, timeout=10)
             except ObjecterError:
                 pass                 # retried on the next map change
+        if stale:
+            await asyncio.gather(*(one(*s) for s in stale))
 
     async def _handle_watch_notify(self, conn, msg: Message) -> None:
         payload = msg.segments[0] if msg.segments else b""
-        for (pool_id, oid, cookie), cb in list(self._watches.items()):
+        for (pool_id, oid, cookie), w in list(self._watches.items()):
             if pool_id == msg.data.get("pool") \
                     and oid == msg.data.get("oid") \
                     and cookie == msg.data.get("cookie"):
                 try:
-                    res = cb(payload)
+                    res = w["cb"](payload)
                     if asyncio.iscoroutine(res):
                         await res
                 except Exception:

@@ -128,14 +128,19 @@ class IoCtx:
             old["seq"], [s for s in old["snaps"] if s != int(snapid)])
 
     async def _op(self, oid: str, ops: list[dict],
-                  extra: dict | None = None) -> tuple[dict, list]:
+                  extra: dict | None = None,
+                  timeout: float | None = None) -> tuple[dict, list]:
         snapc = getattr(self, "_snapc", None)
         if snapc and any(o["op"] in _WRITE_OPS for o in ops):
             extra = {**(extra or {}), "snapc": snapc}
+        kwargs = {}
+        if timeout is not None:
+            kwargs = {"timeout": timeout + 5,
+                      "attempt_timeout": timeout + 3}
         try:
             reply = await self.objecter.op_submit(self.pool_id, oid, ops,
                                                   nspace=self.nspace,
-                                                  extra=extra)
+                                                  extra=extra, **kwargs)
         except ObjecterError as e:
             raise RadosError("ETIMEDOUT", str(e)) from e
         if "err" in reply.data:
@@ -174,7 +179,8 @@ class IoCtx:
         # PG keys watchers by (client entity, cookie)
         cookie = next(self.objecter._tid)
         await self._op(oid, [{"op": "watch", "cookie": cookie}])
-        self.objecter.register_watch(self.pool_id, oid, cookie, callback)
+        self.objecter.register_watch(self.pool_id, oid, cookie, callback,
+                                     nspace=self.nspace)
         return cookie
 
     async def unwatch(self, oid: str, cookie: int) -> None:
@@ -185,8 +191,12 @@ class IoCtx:
                      timeout: float = 5.0) -> dict:
         """Send ``payload`` to every watcher; returns {acks, timeouts}
         after all watchers answered or the timeout lapsed."""
+        # the server waits up to `timeout` for watcher acks before
+        # replying: the op attempt window must outlast it or the
+        # objecter would resend and duplicate deliveries
         data, _ = await self._op(oid, [
-            {"op": "notify", "data": payload, "timeout": timeout}])
+            {"op": "notify", "data": payload, "timeout": timeout}],
+            timeout=timeout)
         return _check(data["results"])
 
     async def list_watchers(self, oid: str) -> list:

@@ -40,6 +40,19 @@ def _load():
         if not _LIB_PATH.exists():
             return None
         lib = ctypes.CDLL(str(_LIB_PATH))
+        if not hasattr(lib, "crush_oracle_select") and not _build_attempted:
+            # stale .so from before the oracle landed: rebuild once
+            _build_attempted = True
+            try:
+                subprocess.run(["make", "-C", str(_NATIVE_DIR), "clean"],
+                               check=True, capture_output=True, timeout=60)
+                subprocess.run(["make", "-C", str(_NATIVE_DIR), "-j4"],
+                               check=True, capture_output=True, timeout=120)
+                lib = ctypes.CDLL(str(_LIB_PATH))
+            except Exception:
+                return None
+        if not hasattr(lib, "crush_oracle_select"):
+            return None
         lib.gf8_matmul.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
@@ -50,6 +63,15 @@ def _load():
             ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
         lib.rjenkins_hash3.restype = ctypes.c_uint32
         lib.rjenkins_hash3.argtypes = [ctypes.c_uint32] * 3
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.crush_oracle_select.restype = ctypes.c_int
+        lib.crush_oracle_select.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, i32p, i32p, i32p, i32p, i32p, i32p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int32, ctypes.c_uint32,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, i32p,
+        ]
         _lib = lib
         return _lib
 
@@ -115,3 +137,91 @@ class NativeBackend:
 
     def matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
         return gf8_matmul(matrix, data)
+
+
+def crush_oracle_do_rule(crush_map, ruleno: int, x: int, numrep: int,
+                         osd_weights) -> list[int] | None:
+    """Independent C oracle for straw2 TAKE->CHOOSE(LEAF)->EMIT rules
+    (native/crush_oracle.cc); None when the native lib is unavailable
+    or the rule shape is outside the oracle's scope."""
+    lib = _load()
+    if lib is None:
+        return None
+    from .crush.ln import RH_LH_TBL, LL_TBL
+    from .crush.types import (
+        CRUSH_BUCKET_STRAW2, CRUSH_RULE_TAKE, CRUSH_RULE_CHOOSE_FIRSTN,
+        CRUSH_RULE_CHOOSE_INDEP, CRUSH_RULE_CHOOSELEAF_FIRSTN,
+        CRUSH_RULE_CHOOSELEAF_INDEP, CRUSH_RULE_EMIT,
+        CRUSH_RULE_SET_CHOOSE_TRIES, CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+    )
+    rule = crush_map.rules.get(ruleno)
+    if rule is None or not (1 <= numrep <= 64):
+        return None
+    choose_tries_override = None
+    leaf_tries_override = None
+    steps = []
+    for s in rule.steps:
+        if s.op == CRUSH_RULE_SET_CHOOSE_TRIES:
+            choose_tries_override = s.arg1
+        elif s.op == CRUSH_RULE_SET_CHOOSELEAF_TRIES:
+            leaf_tries_override = s.arg1
+        else:
+            steps.append(s)
+    if len(steps) != 3:
+        return None
+    take, choose, emit = steps
+    if take.op != CRUSH_RULE_TAKE or emit.op != CRUSH_RULE_EMIT:
+        return None
+    shapes = {
+        CRUSH_RULE_CHOOSE_FIRSTN: (1, 0),
+        CRUSH_RULE_CHOOSELEAF_FIRSTN: (1, 1),
+        CRUSH_RULE_CHOOSE_INDEP: (0, 0),
+        CRUSH_RULE_CHOOSELEAF_INDEP: (0, 1),
+    }
+    if choose.op not in shapes:
+        return None
+    firstn, leaf = shapes[choose.op]
+    t = crush_map.tunables
+    if t.chooseleaf_vary_r != 1 or not t.chooseleaf_stable \
+            or t.choose_local_tries or t.choose_local_fallback_tries:
+        return None                   # oracle implements jewel profile
+    buckets = list(crush_map.buckets.values())
+    if any(b.alg != CRUSH_BUCKET_STRAW2 for b in buckets):
+        return None
+    ids = np.array([b.id for b in buckets], np.int32)
+    types = np.array([b.type for b in buckets], np.int32)
+    off = np.zeros(len(buckets) + 1, np.int32)
+    items, weights = [], []
+    for i, b in enumerate(buckets):
+        items.extend(b.items)
+        weights.extend(b.item_weights)
+        off[i + 1] = len(items)
+    items = np.array(items, np.int32)
+    weights = np.array(weights, np.int32)
+    osd_w = np.asarray(osd_weights, np.int32)
+    out = np.full(max(numrep, 1), 0x7FFFFFFF, np.int32)
+    rh = np.ascontiguousarray(RH_LH_TBL, np.int64)
+    ll = np.ascontiguousarray(LL_TBL, np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    # default counts tries (total_tries + 1); an explicit SET step is
+    # used as-is (crush_do_rule's compatibility quirk)
+    choose_tries = choose_tries_override or (t.choose_total_tries + 1)
+    if leaf_tries_override:
+        recurse_tries = leaf_tries_override
+    elif firstn:
+        recurse_tries = 1 if t.chooseleaf_descend_once else choose_tries
+    else:
+        recurse_tries = 1
+    n = lib.crush_oracle_select(
+        rh.ctypes.data_as(i64p), ll.ctypes.data_as(i64p),
+        len(buckets), ids.ctypes.data_as(i32p),
+        types.ctypes.data_as(i32p), off.ctypes.data_as(i32p),
+        items.ctypes.data_as(i32p), weights.ctypes.data_as(i32p),
+        osd_w.ctypes.data_as(i32p), len(osd_w),
+        crush_map.max_devices, take.arg1, ctypes.c_uint32(x & 0xFFFFFFFF),
+        numrep, choose.arg2, firstn, leaf,
+        choose_tries, recurse_tries, 1,
+        out.ctypes.data_as(i32p))
+    return [int(v) for v in out[:n]] if firstn else \
+        [int(v) for v in out[:numrep]]

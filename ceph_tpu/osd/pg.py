@@ -775,14 +775,14 @@ class PG:
             result = {"ok": True, "acks": acks, "timeouts": missed}
 
             async def wait_acks():
+                # one shared deadline, all watchers concurrently -- a
+                # serial wait would stack timeouts per slow watcher
+                if waiting:
+                    await asyncio.wait([f for _, _, f in waiting],
+                                       timeout=timeout)
                 for who, nid, fut in waiting:
-                    try:
-                        await asyncio.wait_for(fut, timeout)
-                        acks.append(who)
-                    except asyncio.TimeoutError:
-                        missed.append(who)
-                    finally:
-                        self.osd._notify_waiters.pop(nid, None)
+                    (acks if fut.done() else missed).append(who)
+                    self.osd._notify_waiters.pop(nid, None)
             result["__wait"] = wait_acks
             return result
         return {"err": f"EOPNOTSUPP {name}"}
@@ -864,9 +864,14 @@ class PG:
         ss = load_snapset(self.osd.store, self.coll, oid)
         seq = int(snapc.get("seq", 0))
         exists = self.osd.store.exists(self.coll, oid)
+        # a stale client snapc may still list snaps that were removed
+        # and trimmed -- cloning for them would leak untrimmable clones
+        # (make_writeable filters against removed_snaps the same way)
+        removed = set(getattr(self.pool, "removed_snaps", []))
         if exists and seq > ss["seq"]:
             newly = sorted(int(s) for s in snapc.get("snaps", [])
-                           if int(s) > ss["seq"])
+                           if int(s) > ss["seq"]
+                           and int(s) not in removed)
             if newly:
                 cid = newly[-1]
                 centry = LogEntry(

@@ -1,0 +1,280 @@
+// Scalar CRUSH oracle: an independent C implementation of the straw2
+// firstn / chooseleaf-firstn / indep decision flows (the semantics of
+// src/crush/mapper.c:441-825 under jewel tunables: choose_total_tries,
+// chooseleaf_vary_r=1, chooseleaf_stable=1, no local retries).  It
+// validates the Python scalar engine (ceph_tpu/crush/mapper.py) and
+// the vectorized JAX mapper lane-for-lane over randomized maps -- a
+// placement bug in one implementation cannot hide in all three.
+//
+// The map arrives flattened (CSR): buckets indexed 0..n_buckets-1 with
+// id, type and an item/weight slice each; straw2 only (the bucket
+// algorithm every map this framework builds uses).  The crush_ln
+// fixed-point tables are passed in from Python so all implementations
+// share the single committed table artifact.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" uint32_t rjenkins_hash2(uint32_t a, uint32_t b);
+extern "C" uint32_t rjenkins_hash3(uint32_t a, uint32_t b, uint32_t c);
+
+namespace {
+
+constexpr int32_t kNone = 0x7fffffff;   // CRUSH_ITEM_NONE
+constexpr int32_t kUndef = 0x7ffffffe;  // CRUSH_ITEM_UNDEF
+
+struct Map {
+  const int64_t* rh_lh;      // 258 entries, index bias -256
+  const int64_t* ll;         // 256 entries
+  int n_buckets;
+  const int32_t* ids;        // bucket id (negative)
+  const int32_t* types;      // bucket type
+  const int32_t* off;        // CSR offsets (n_buckets+1)
+  const int32_t* items;      // concatenated child ids
+  const int32_t* weights;    // concatenated child weights (16.16)
+  const int32_t* osd_w;      // per-osd in/reweight vector
+  int n_osds;
+  int max_devices;
+  int choose_tries;
+  int recurse_tries;
+};
+
+int bucket_index(const Map& m, int32_t id) {
+  for (int i = 0; i < m.n_buckets; i++)
+    if (m.ids[i] == id) return i;
+  return -1;
+}
+
+int64_t crush_ln(const Map& m, uint32_t xin) {
+  uint32_t x = xin + 1;
+  int iexpon = 15;
+  if (!(x & 0x18000)) {
+    int bits = 0;
+    uint32_t v = x & 0x1FFFF;
+    while (!(v & 0x8000) && bits < 16) { v <<= 1; bits++; }
+    x <<= bits;
+    iexpon = 15 - bits;
+  }
+  uint32_t index1 = (x >> 8) << 1;
+  int64_t rh = m.rh_lh[index1 - 256];
+  int64_t lh = m.rh_lh[index1 + 1 - 256];
+  uint64_t xl64 = ((uint64_t)x * (uint64_t)rh) >> 48;
+  int64_t result = (int64_t)iexpon << 44;
+  int64_t lll = m.ll[xl64 & 0xFF];
+  lh += lll;
+  result += lh >> 4;
+  return result;
+}
+
+int64_t draw_exp(const Map& m, uint32_t x, int32_t item, int32_t r,
+                 int32_t weight) {
+  uint32_t u = rjenkins_hash3(x, (uint32_t)item, (uint32_t)r) & 0xFFFF;
+  int64_t ln = crush_ln(m, u) - 0x1000000000000LL;
+  // C99 signed division truncates toward zero
+  return ln / (int64_t)weight;
+}
+
+int32_t straw2_choose(const Map& m, int bi, uint32_t x, int32_t r) {
+  int lo = m.off[bi], hi = m.off[bi + 1];
+  int high = lo;
+  int64_t high_draw = 0;
+  for (int i = lo; i < hi; i++) {
+    int64_t draw;
+    if (m.weights[i])
+      draw = draw_exp(m, x, m.items[i], r, m.weights[i]);
+    else
+      draw = INT64_MIN;
+    if (i == lo || draw > high_draw) { high = i; high_draw = draw; }
+  }
+  return m.items[high];
+}
+
+bool is_out(const Map& m, int32_t item, uint32_t x) {
+  if (item >= m.n_osds) return true;
+  int32_t w = m.osd_w[item];
+  if (w >= 0x10000) return false;
+  if (w == 0) return true;
+  return (rjenkins_hash2(x, (uint32_t)item) & 0xFFFF) >= (uint32_t)w;
+}
+
+int item_type(const Map& m, int32_t item) {
+  if (item >= 0) return 0;
+  int bi = bucket_index(m, item);
+  return bi < 0 ? -1 : m.types[bi];
+}
+
+int choose_firstn(const Map& m, int bucket_bi, uint32_t x, int numrep,
+                  int choose_type, int32_t* out, int outpos,
+                  int out_size, int tries, int recurse_tries,
+                  bool recurse_to_leaf, int32_t* out2, int parent_r,
+                  bool stable) {
+  int count = out_size;
+  int rep = stable ? 0 : outpos;
+  while (rep < numrep && count > 0) {
+    int ftotal = 0;
+    bool skip_rep = false;
+    int32_t item = 0;
+    for (;;) {  // retry_descent
+      bool retry_descent = false;
+      int in_bi = bucket_bi;
+      for (;;) {  // retry_bucket
+        bool retry_bucket = false;
+        bool collide = false;
+        bool reject = false;
+        int32_t r = rep + parent_r + ftotal;
+        if (m.off[in_bi + 1] == m.off[in_bi]) {
+          reject = true;
+        } else {
+          item = straw2_choose(m, in_bi, x, r);
+          if (item >= m.max_devices) { skip_rep = true; break; }
+          int itype = item_type(m, item);
+          if (itype != choose_type) {
+            int sub = bucket_index(m, item);
+            if (item >= 0 || sub < 0) { skip_rep = true; break; }
+            in_bi = sub;
+            retry_bucket = true;
+            continue;
+          }
+          for (int i = 0; i < outpos; i++)
+            if (out[i] == item) { collide = true; break; }
+          if (!collide && recurse_to_leaf) {
+            if (item < 0) {
+              // chooseleaf_vary_r=1: sub_r = r >> 0
+              int sub_r = r;
+              int sub_bi = bucket_index(m, item);
+              if (choose_firstn(m, sub_bi, x,
+                                stable ? 1 : outpos + 1, 0,
+                                out2, outpos, count, recurse_tries, 0,
+                                false, nullptr, sub_r,
+                                stable) <= outpos)
+                reject = true;
+            } else {
+              out2[outpos] = item;
+            }
+          }
+          if (!reject && !collide && choose_type == 0)
+            reject = is_out(m, item, x);
+        }
+        if (reject || collide) {
+          ftotal++;
+          if (ftotal < tries) retry_descent = true;
+          else skip_rep = true;
+        }
+        if (!retry_bucket) break;
+      }
+      if (!retry_descent) break;
+    }
+    if (skip_rep) { rep++; continue; }
+    out[outpos] = item;
+    outpos++;
+    count--;
+    rep++;
+  }
+  return outpos;
+}
+
+void choose_indep(const Map& m, int bucket_bi, uint32_t x, int left,
+                  int numrep, int choose_type, int32_t* out, int outpos,
+                  int tries, int recurse_tries, bool recurse_to_leaf,
+                  int32_t* out2, int parent_r) {
+  int endpos = outpos + left;
+  for (int rep = outpos; rep < endpos; rep++) {
+    out[rep] = kUndef;
+    if (out2) out2[rep] = kUndef;
+  }
+  int ftotal = 0;
+  while (left > 0 && ftotal < tries) {
+    for (int rep = outpos; rep < endpos; rep++) {
+      if (out[rep] != kUndef) continue;
+      int in_bi = bucket_bi;
+      for (;;) {
+        int32_t r = rep + parent_r + numrep * ftotal;  // straw2: no
+        // uniform-bucket special case (straw2-only maps)
+        if (m.off[in_bi + 1] == m.off[in_bi]) break;
+        int32_t item = straw2_choose(m, in_bi, x, r);
+        if (item >= m.max_devices) {
+          out[rep] = kNone;
+          if (out2) out2[rep] = kNone;
+          left--;
+          break;
+        }
+        int itype = item_type(m, item);
+        if (itype != choose_type) {
+          int sub = bucket_index(m, item);
+          if (item >= 0 || sub < 0) {
+            out[rep] = kNone;
+            if (out2) out2[rep] = kNone;
+            left--;
+            break;
+          }
+          in_bi = sub;
+          continue;
+        }
+        bool collide = false;
+        for (int i = outpos; i < endpos; i++)
+          if (out[i] == item) { collide = true; break; }
+        if (collide) break;
+        if (recurse_to_leaf) {
+          if (item < 0) {
+            int sub_bi = bucket_index(m, item);
+            choose_indep(m, sub_bi, x, 1, numrep, 0, out2, rep,
+                         recurse_tries, 0, false, nullptr, r);
+            if (out2 && out2[rep] == kNone) break;
+          } else if (out2) {
+            out2[rep] = item;
+          }
+        }
+        if (itype == 0 && is_out(m, item, x)) break;
+        out[rep] = item;
+        left--;
+        break;
+      }
+    }
+    ftotal++;
+  }
+  for (int rep = outpos; rep < endpos; rep++) {
+    if (out[rep] == kUndef) out[rep] = kNone;
+    if (out2 && out2[rep] == kUndef) out2[rep] = kNone;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// One TAKE root -> (CHOOSELEAF_{FIRSTN,INDEP} | CHOOSE_{FIRSTN,INDEP})
+// -> EMIT rule.  Returns the number of result slots written.
+int crush_oracle_select(
+    const int64_t* rh_lh, const int64_t* ll,
+    int n_buckets, const int32_t* ids, const int32_t* types,
+    const int32_t* off, const int32_t* items, const int32_t* weights,
+    const int32_t* osd_w, int n_osds, int max_devices,
+    int32_t root_id, uint32_t x, int numrep, int choose_type,
+    int firstn, int leaf, int choose_tries, int recurse_tries,
+    int stable, int32_t* out) {
+  if (numrep < 1 || numrep > 64) return 0;  // fixed result buffers
+  Map m{rh_lh, ll, n_buckets, ids, types, off, items, weights,
+        osd_w, n_osds, max_devices, choose_tries, recurse_tries};
+  int root_bi = bucket_index(m, root_id);
+  if (root_bi < 0) return 0;
+  int32_t tmp[64];
+  int32_t out2[64];
+  for (int i = 0; i < 64; i++) { tmp[i] = kNone; out2[i] = kNone; }
+  if (firstn) {
+    int got = choose_firstn(m, root_bi, x, numrep, choose_type, tmp, 0,
+                            numrep, choose_tries, recurse_tries,
+                            leaf != 0, leaf ? out2 : nullptr, 0,
+                            /*stable=*/true);
+    const int32_t* src = leaf ? out2 : tmp;
+    for (int i = 0; i < got; i++) out[i] = src[i];
+    return got;
+  }
+  choose_indep(m, root_bi, x, numrep, numrep, choose_type, tmp, 0,
+               choose_tries, recurse_tries, leaf != 0,
+               leaf ? out2 : nullptr, 0);
+  const int32_t* src = leaf ? out2 : tmp;
+  for (int i = 0; i < numrep; i++) out[i] = src[i];
+  return numrep;
+}
+
+}  // extern "C"

@@ -88,16 +88,20 @@ class Objecter:
             pool_id, oid, cookie = key
             target = self.calc_target(pool_id, oid, w["nspace"])
             if target != w["target"]:
-                w["target"] = target
-                stale.append((pool_id, oid, cookie, w["nspace"]))
+                stale.append((key, w, target))
 
-        async def one(pool_id, oid, cookie, nspace):
+        async def one(key, w, target):
+            pool_id, oid, cookie = key
             try:
                 await self.op_submit(pool_id, oid,
                                      [{"op": "watch", "cookie": cookie}],
-                                     nspace=nspace, timeout=10)
+                                     nspace=w["nspace"], timeout=10)
+                # only a SUCCESSFUL re-registration settles the target;
+                # a failure leaves it stale so the next map change (or
+                # repeated attempt) retries
+                w["target"] = target
             except ObjecterError:
-                pass                 # retried on the next map change
+                pass
         if stale:
             await asyncio.gather(*(one(*s) for s in stale))
 

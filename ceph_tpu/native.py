@@ -40,9 +40,9 @@ def _load():
         if not _LIB_PATH.exists():
             return None
         lib = ctypes.CDLL(str(_LIB_PATH))
-        if not hasattr(lib, "crush_oracle_select") and not _build_attempted:
-            # stale .so from before the oracle landed: rebuild once
-            _build_attempted = True
+        if not hasattr(lib, "crush_oracle_select"):
+            # stale .so from before the oracle landed: rebuild once;
+            # if that fails, keep serving the symbols it DOES have
             try:
                 subprocess.run(["make", "-C", str(_NATIVE_DIR), "clean"],
                                check=True, capture_output=True, timeout=60)
@@ -50,9 +50,7 @@ def _load():
                                check=True, capture_output=True, timeout=120)
                 lib = ctypes.CDLL(str(_LIB_PATH))
             except Exception:
-                return None
-        if not hasattr(lib, "crush_oracle_select"):
-            return None
+                pass
         lib.gf8_matmul.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
@@ -63,15 +61,18 @@ def _load():
             ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
         lib.rjenkins_hash3.restype = ctypes.c_uint32
         lib.rjenkins_hash3.argtypes = [ctypes.c_uint32] * 3
-        i32p = ctypes.POINTER(ctypes.c_int32)
-        lib.crush_oracle_select.restype = ctypes.c_int
-        lib.crush_oracle_select.argtypes = [
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int, i32p, i32p, i32p, i32p, i32p, i32p,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int32, ctypes.c_uint32,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, i32p,
-        ]
+        if hasattr(lib, "crush_oracle_select"):
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            lib.crush_oracle_select.restype = ctypes.c_int
+            lib.crush_oracle_select.argtypes = [
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int, i32p, i32p, i32p, i32p, i32p, i32p,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int32,
+                ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, i32p,
+            ]
         _lib = lib
         return _lib
 
@@ -145,7 +146,7 @@ def crush_oracle_do_rule(crush_map, ruleno: int, x: int, numrep: int,
     (native/crush_oracle.cc); None when the native lib is unavailable
     or the rule shape is outside the oracle's scope."""
     lib = _load()
-    if lib is None:
+    if lib is None or not hasattr(lib, "crush_oracle_select"):
         return None
     from .crush.ln import RH_LH_TBL, LL_TBL
     from .crush.types import (
@@ -179,6 +180,10 @@ def crush_oracle_do_rule(crush_map, ruleno: int, x: int, numrep: int,
         CRUSH_RULE_CHOOSELEAF_INDEP: (0, 1),
     }
     if choose.op not in shapes:
+        return None
+    if choose.arg1 != 0:
+        return None   # rule-capped numrep: outside the oracle's scope
+    if (choose_tries_override or 0) < 0 or (leaf_tries_override or 0) < 0:
         return None
     firstn, leaf = shapes[choose.op]
     t = crush_map.tunables

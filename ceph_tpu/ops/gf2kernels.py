@@ -171,6 +171,85 @@ def _make_pallas_batch_fn(r8: int, k: int, b: int, l: int, tile: int,
     return jax.jit(fn)
 
 
+# ---------------------------------------------------------------------------
+# MXU-packed kernel (v2): the original kernel keeps the systolic array
+# ~9% utilized -- the bit-matmul's contraction is only 8k<=64 of the
+# MXU's 128 rows, and the int32-widened unpack plus the sublane-strided
+# pack burn VPU cycles on relayouts.  This variant:
+#   * packs TWO stripes per grid step so the contraction is 16k (=128
+#     for the headline k=8): every MXU column-cycle carries two byte
+#     columns of work;
+#   * unpacks with int8 mask-compares concatenated PLANE-MAJOR (no
+#     int32 widening, no stack+reshape relayout) against a column-
+#     permuted W;
+#   * packs with the same (r,8,T) shift-sum but on the un-interleaved
+#     row halves.
+# Byte-identical to the host path; selected at runtime with a parity
+# self-check and transparent fallback to the v1 kernel.
+
+@functools.lru_cache(maxsize=64)
+def _w_g2_planemajor(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
+    """(2*8r, 16k) int8: block-diagonal-by-stripe W whose columns match
+    the plane-major concat layout of unpacked concat(stripeA, stripeB):
+    RHS row s*2k + j  <->  bit s of chunk j (j<k: stripe A, else B)."""
+    w = _bitmatrix_cached(mat_bytes, r, k)      # (8r, 8k), col 8j+s
+    r8 = 8 * r
+    out = np.zeros((2 * r8, 16 * k), np.int8)
+    for s in range(8):
+        for j in range(2 * k):
+            stripe, jj = divmod(j, k)
+            out[stripe * r8:(stripe + 1) * r8, s * 2 * k + j] = \
+                w[:, 8 * jj + s]
+    return out
+
+
+def _unpack_planes_i8(x):
+    """(nk, t) uint8 -> (8*nk, t) int8, plane-major, no i32 widening."""
+    ps = [(x & np.uint8(1 << s)).astype(jnp.bool_).astype(jnp.int8)
+          for s in range(8)]
+    return jnp.concatenate(ps, axis=0)
+
+
+def _pack_rows(acc, r: int):
+    t = acc.shape[-1]
+    b = acc.reshape(r, 8, t)
+    shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
+    return (b << shifts).sum(axis=1).astype(jnp.uint8)
+
+
+def _make_pallas_batch_fn_g2(r8: int, k: int, b: int, l: int, tile: int,
+                             interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = r8 // 8
+
+    def kernel(w_ref, d_ref, o_ref):
+        x = jnp.concatenate([d_ref[0], d_ref[1]], axis=0)   # (2k, T)
+        bits = _unpack_planes_i8(x)                  # (16k, T)
+        acc = jax.lax.dot_general(
+            w_ref[:], bits, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32) & 1           # (2*8r, T)
+        o_ref[0] = _pack_rows(acc[:r8], r)
+        o_ref[1] = _pack_rows(acc[r8:], r)
+
+    fn = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, r, l), jnp.uint8),
+        grid=(b // 2, l // tile),
+        in_specs=[
+            pl.BlockSpec((2 * r8, 16 * k), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, k, tile), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((2, r, tile), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
 @functools.lru_cache(maxsize=512)
 def _compiled(r8: int, k: int, n_padded: int, use_pallas: bool):
     if use_pallas:
@@ -181,10 +260,10 @@ def _compiled(r8: int, k: int, n_padded: int, use_pallas: bool):
 
 
 def clear_kernel_cache() -> None:
-    _compiled.cache_clear()
-    _compiled_batch.cache_clear()
-    _bitmatrix_cached.cache_clear()
-    _bitmatrix_device.cache_clear()
+    for fn in (_compiled, _compiled_batch, _compiled_batch_g2,
+               _w_g2_device, _bitmatrix_cached, _bitmatrix_device):
+        getattr(fn, "cache_clear", lambda: None)()
+    _g2_health.clear()
 
 
 def _want_pallas() -> bool:
@@ -229,16 +308,78 @@ def gf_matmul_device(matrix: np.ndarray, data, *, out_np: bool = True):
     return np.asarray(out) if out_np else out
 
 
+@functools.lru_cache(maxsize=256)
+def _w_g2_device(mat_bytes: bytes, r: int, k: int):
+    return jax.device_put(_w_g2_planemajor(mat_bytes, r, k))
+
+
+def _pick_tile(l: int) -> int:
+    """Lane-tile ladder shared by the batch kernels; 0 = ineligible."""
+    if l % LANE_TILE == 0:
+        return LANE_TILE
+    if l <= LANE_TILE and l % 128 == 0:
+        return l
+    return 0
+
+
+@functools.lru_cache(maxsize=512)
+def _compiled_batch_g2(r8: int, k: int, b: int, l: int):
+    interpret = bool(os.environ.get("CEPH_TPU_PALLAS_INTERPRET"))
+    tile = _pick_tile(l)
+    if not tile:
+        return None
+    return _make_pallas_batch_fn_g2(r8, k, b, l, tile,
+                                    interpret=interpret)
+
+
+# per (matrix, shape) health of the v2 kernel: None=untested (parity
+# gate runs on first use), True=good, False=fall back to v1
+_g2_health: dict[tuple, bool] = {}
+
+
+def _try_g2(matrix: np.ndarray, xd, b: int, k: int, l: int):
+    """Run the MXU-packed kernel when eligible; returns the output or
+    None (ineligible / failed / parity-rejected -> caller falls back)."""
+    if os.environ.get("CEPH_TPU_NO_G2") or not _want_pallas():
+        return None
+    if k > 8 or k < 1 or b % 2 or b < 2:
+        return None                  # contraction 16k must fit 128 rows
+    mat_bytes = matrix.tobytes()
+    r = matrix.shape[0]
+    key = (mat_bytes, b, l)
+    if _g2_health.get(key) is False:
+        return None
+    try:
+        fn = _compiled_batch_g2(8 * r, k, b, l)
+        if fn is None:
+            _g2_health[key] = False
+            return None
+        w2 = _w_g2_device(mat_bytes, r, k)
+        out = fn(w2, xd)
+        if key not in _g2_health:
+            # one-time byte-parity gate vs the host oracle on a small
+            # slice; a silently-wrong kernel must never serve
+            from ..gf import gf_matmul
+            ncheck = min(256, l)
+            got = np.asarray(out[:2, :, :ncheck])
+            sample = np.asarray(xd[:2, :, :ncheck])
+            for i in range(2):
+                if not np.array_equal(got[i],
+                                      gf_matmul(matrix, sample[i])):
+                    _g2_health[key] = False
+                    return None
+            _g2_health[key] = True
+        return out
+    except Exception:
+        _g2_health[key] = False
+        return None
+
+
 @functools.lru_cache(maxsize=512)
 def _compiled_batch(r8: int, k: int, b: int, l: int, use_pallas: bool):
     interpret = bool(os.environ.get("CEPH_TPU_PALLAS_INTERPRET"))
     if use_pallas:
-        if l % LANE_TILE == 0:
-            tile = LANE_TILE
-        elif l <= LANE_TILE and l % 128 == 0:
-            tile = l
-        else:
-            tile = 0
+        tile = _pick_tile(l)
         if tile:
             return _make_pallas_batch_fn(r8, k, b, l, tile,
                                          interpret=interpret)
@@ -255,10 +396,15 @@ def gf_matmul_batch_device(matrix: np.ndarray, data, *, out_np: bool = False):
 
     Eager op-by-op dispatch is a tunnel round trip per op when the chip
     is remote; everything (including layout changes) lives under one jit.
+    The MXU-packed v2 kernel serves when eligible (parity-gated, with
+    transparent fallback to the v1 kernel / XLA path).
     """
     b, k, l = data.shape
-    w = bitmatrix_device(matrix)
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     xd = jnp.asarray(data, dtype=jnp.uint8)
-    fn = _compiled_batch(w.shape[0], k, b, l, _want_pallas())
-    out = fn(w, xd)
+    out = _try_g2(matrix, xd, b, k, l)
+    if out is None:
+        w = bitmatrix_device(matrix)
+        fn = _compiled_batch(w.shape[0], k, b, l, _want_pallas())
+        out = fn(w, xd)
     return np.asarray(out) if out_np else out

@@ -86,3 +86,56 @@ def test_tpu_plugin_batch_roundtrip(registry):
     rec = np.asarray(tpu.decode_batch(erasures, survivors, out_np=True))
     assert np.array_equal(rec[:, 0, :], full[:, 0, :])
     assert np.array_equal(rec[:, 1, :], full[:, 9, :])
+
+
+def test_pallas_g2_kernel_interpret_parity():
+    """The MXU-packed v2 kernel (two stripes per step, plane-major
+    int8 unpack, contraction 16k) in interpret mode, byte-exact vs the
+    host oracle, encode and decode shapes."""
+    import jax.numpy as jnp
+    from ceph_tpu.ops.gf2kernels import _make_pallas_batch_fn_g2, \
+        _w_g2_planemajor
+    from ceph_tpu.gf import build_decode_matrix
+
+    rng = np.random.default_rng(11)
+    k, m, b, l = 8, 3, 4, 512
+    gen = gen_rs_matrix(k + m, k)
+    data = rng.integers(0, 256, size=(b, k, l)).astype(np.uint8)
+
+    for mat in (gen[k:],
+                build_decode_matrix(gen, k, [1, 9])[0]):
+        mat = np.ascontiguousarray(mat, np.uint8)
+        w2 = _w_g2_planemajor(mat.tobytes(), mat.shape[0], k)
+        fn = _make_pallas_batch_fn_g2(8 * mat.shape[0], k, b, l, 256,
+                                      interpret=True)
+        got = np.asarray(fn(jnp.asarray(w2), jnp.asarray(data)))
+        for i in range(b):
+            assert np.array_equal(got[i], gf_matmul(mat, data[i])), i
+
+
+def test_g2_selection_and_fallback(monkeypatch):
+    """gf_matmul_batch_device serves the v2 kernel when healthy and
+    falls back transparently when the kernel errors."""
+    import ceph_tpu.ops.gf2kernels as g
+
+    monkeypatch.setenv("CEPH_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setattr(g, "_want_pallas", lambda: True)
+    g.clear_kernel_cache()
+    rng = np.random.default_rng(12)
+    k, m, b, l = 8, 3, 4, 512
+    gen = gen_rs_matrix(k + m, k)
+    data = rng.integers(0, 256, size=(b, k, l)).astype(np.uint8)
+    out = g.gf_matmul_batch_device(gen[k:], data, out_np=True)
+    for i in range(b):
+        assert np.array_equal(out[i], gf_matmul(gen[k:], data[i]))
+    mat = np.ascontiguousarray(gen[k:], np.uint8)
+    assert g._g2_health.get((mat.tobytes(), b, l)) is True
+
+    # sabotage the g2 compile: the fallback must still serve parity
+    g.clear_kernel_cache()
+    monkeypatch.setattr(g, "_compiled_batch_g2",
+                        lambda *a: (_ for _ in ()).throw(RuntimeError()))
+    out = g.gf_matmul_batch_device(gen[k:], data, out_np=True)
+    for i in range(b):
+        assert np.array_equal(out[i], gf_matmul(gen[k:], data[i]))
+    g.clear_kernel_cache()

@@ -261,7 +261,8 @@ def _compiled(r8: int, k: int, n_padded: int, use_pallas: bool):
 
 def clear_kernel_cache() -> None:
     for fn in (_compiled, _compiled_batch, _compiled_batch_g2,
-               _w_g2_device, _bitmatrix_cached, _bitmatrix_device):
+               _w_g2_device, _w_g2_planemajor, _bitmatrix_cached,
+               _bitmatrix_device):
         getattr(fn, "cache_clear", lambda: None)()
     _g2_health.clear()
 

@@ -119,8 +119,10 @@ class RadosStriper:
             try:
                 buf = await self.ioctx.read(self._obj(soid, objectno),
                                             length=n, offset=obj_off)
-            except RadosError:
-                buf = b""                     # sparse hole
+            except RadosError as e:
+                if e.errno_name != "ENOENT":
+                    raise             # timeouts etc. must surface,
+                buf = b""             # only absence is a sparse hole
             return buf + b"\0" * (n - len(buf))
 
         pieces = await asyncio.gather(
@@ -133,46 +135,52 @@ class RadosStriper:
             raw = await self.ioctx.get_xattr(self._obj(soid, 0),
                                              SIZE_XATTR)
             return int(raw)
-        except RadosError:
-            return 0
+        except RadosError as e:
+            if e.errno_name in ("ENOENT", "ENODATA"):
+                return 0              # object absent = size 0
+            raise                     # a timeout is NOT "empty"
 
     async def stat(self, soid: str) -> dict:
         return {"size": await self.size(soid),
                 "layout": self.layout}
 
     async def truncate(self, soid: str, size: int) -> None:
-        old = await self.size(soid)
-        if size < old:
-            # drop whole objects beyond the new end, trim the boundary
-            keep = map_extents(self.layout, 0, size) if size else []
-            keep_max = max((e[0] for e in keep), default=-1)
-            last = map_extents(self.layout, 0, old)
-            n_objs = max((e[0] for e in last), default=-1) + 1
-            from .rados import RadosError
+        async with self._size_lock(soid):
+            old = await self.size(soid)
+            if size < old:
+                # drop whole objects beyond the new end, trim boundary
+                keep = map_extents(self.layout, 0, size) if size else []
+                keep_max = max((e[0] for e in keep), default=-1)
+                last = map_extents(self.layout, 0, old)
+                n_objs = max((e[0] for e in last), default=-1) + 1
+                from .rados import RadosError
 
-            async def rm(objectno):
-                try:
-                    await self.ioctx.remove(self._obj(soid, objectno))
-                except RadosError:
-                    pass
-            await asyncio.gather(*(rm(o) for o in
-                                   range(keep_max + 1, n_objs)))
-            if size:
-                boundary = {}
-                for objectno, obj_off, n in keep:
-                    boundary[objectno] = max(
-                        boundary.get(objectno, 0), obj_off + n)
-
-                async def trunc(objectno, obj_end):
+                async def rm(objectno):
                     try:
-                        await self.ioctx.truncate(
-                            self._obj(soid, objectno), obj_end)
-                    except RadosError:
-                        pass
-                await asyncio.gather(*(trunc(o, e) for o, e in
-                                       boundary.items()))
-        await self.ioctx.set_xattr(self._obj(soid, 0), SIZE_XATTR,
-                                   str(size).encode())
+                        await self.ioctx.remove(
+                            self._obj(soid, objectno))
+                    except RadosError as e:
+                        if e.errno_name != "ENOENT":
+                            raise
+                await asyncio.gather(*(rm(o) for o in
+                                       range(keep_max + 1, n_objs)))
+                if size:
+                    boundary = {}
+                    for objectno, obj_off, n in keep:
+                        boundary[objectno] = max(
+                            boundary.get(objectno, 0), obj_off + n)
+
+                    async def trunc(objectno, obj_end):
+                        try:
+                            await self.ioctx.truncate(
+                                self._obj(soid, objectno), obj_end)
+                        except RadosError as e:
+                            if e.errno_name != "ENOENT":
+                                raise
+                    await asyncio.gather(*(trunc(o, e) for o, e in
+                                           boundary.items()))
+            await self.ioctx.set_xattr(self._obj(soid, 0), SIZE_XATTR,
+                                       str(size).encode())
 
     async def remove(self, soid: str) -> None:
         size = await self.size(soid)
@@ -184,6 +192,7 @@ class RadosStriper:
         async def rm(objectno):
             try:
                 await self.ioctx.remove(self._obj(soid, objectno))
-            except RadosError:
-                pass
+            except RadosError as e:
+                if e.errno_name != "ENOENT":
+                    raise
         await asyncio.gather(*(rm(o) for o in range(n_objs)))

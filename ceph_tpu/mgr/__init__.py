@@ -3,3 +3,5 @@ src/pybind/mgr): cluster-wide optimization passes that consume the
 OSDMap and emit map mutations.  The balancer is the flagship customer
 of the vectorized CRUSH op -- full-cluster placement recompute in one
 launch."""
+
+from .mgr import Mgr, MgrModule  # noqa: F401,E402

@@ -1,0 +1,255 @@
+"""Manager daemon: module host + daemon metrics aggregation.
+
+The src/mgr stack in miniature: the mgr beacons to the monitor (which
+publishes the active mgr's address to its subscribers, the MgrMap
+analog), receives periodic perf-counter reports from daemons
+(DaemonServer / MgrClient report protocol), and hosts python modules
+with a serve-loop + command surface (the ActivePyModules / MgrModule
+shape).  Built-in modules:
+
+  * balancer     -- periodic upmap optimization (mgr balancer upmap
+                    mode); active when `balancer_active` config is on
+  * pg_autoscaler-- recommends pg_num per pool from utilization
+                    heuristics (report-only: pg splitting/merging is
+                    not implemented)
+  * status       -- cluster + daemon-report summary
+
+Modules answer `mgr_command` messages ({"prefix": "<module> <cmd>"}),
+the `ceph tell mgr` analog.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..msg import Message, Messenger
+from ..mon.osdmap import OSDMap, Incremental
+
+
+class MgrModule:
+    """Module SPI (mgr_module.py analog): override serve/handle."""
+
+    name = "module"
+
+    def __init__(self, mgr: "Mgr") -> None:
+        self.mgr = mgr
+
+    async def serve(self) -> None:
+        """Background loop; cancelled at shutdown."""
+
+    async def handle_command(self, cmd: str, args: dict):
+        raise ValueError(f"unknown command {cmd!r}")
+
+
+class BalancerModule(MgrModule):
+    name = "balancer"
+
+    async def serve(self) -> None:
+        while True:
+            await asyncio.sleep(self.mgr.config["balancer_interval"])
+            if not self.mgr.config["balancer_active"]:
+                continue
+            try:
+                res = await self.mgr.mon_command(
+                    "osd balancer run",
+                    {"max": self.mgr.config["balancer_max_moves"]})
+                if res.get("moved"):
+                    self.mgr.log.append(
+                        f"balancer: moved {res['moved']} pgs "
+                        f"(stddev {res['before']['stddev']} -> "
+                        f"{res['after']['stddev']})")
+            except Exception as e:
+                self.mgr.log.append(f"balancer: {e}")
+
+    async def handle_command(self, cmd: str, args: dict):
+        if cmd == "status":
+            from .balancer import pg_distribution
+            return {"active": self.mgr.config["balancer_active"],
+                    "distribution": pg_distribution(self.mgr.osdmap)}
+        if cmd == "on":
+            self.mgr.config["balancer_active"] = True
+            return "active"
+        if cmd == "off":
+            self.mgr.config["balancer_active"] = False
+            return "inactive"
+        if cmd == "execute":
+            return await self.mgr.mon_command(
+                "osd balancer run",
+                {"max": args.get("max",
+                                 self.mgr.config["balancer_max_moves"])})
+        raise ValueError(f"unknown balancer command {cmd!r}")
+
+
+class PgAutoscalerModule(MgrModule):
+    name = "pg_autoscaler"
+
+    async def handle_command(self, cmd: str, args: dict):
+        if cmd != "status":
+            raise ValueError(f"unknown pg_autoscaler command {cmd!r}")
+        # the reference targets ~100 PGs/OSD scaled by pool bias;
+        # recommendation only (pg splitting is future work)
+        n_osd = sum(1 for i in self.mgr.osdmap.osds.values()
+                    if i.up and i.in_cluster)
+        out = []
+        for pool in self.mgr.osdmap.pools.values():
+            target = max(1, (100 * max(n_osd, 1)) // max(
+                1, len(self.mgr.osdmap.pools)) // max(1, pool.size))
+            # round to the next power of two, the pg_num discipline
+            rec = 1 << max(0, (target - 1).bit_length())
+            out.append({"pool": pool.name, "pg_num": pool.pg_num,
+                        "recommended": rec,
+                        "would_adjust": rec != pool.pg_num})
+        return out
+
+
+class StatusModule(MgrModule):
+    name = "status"
+
+    async def handle_command(self, cmd: str, args: dict):
+        if cmd != "show":
+            raise ValueError(f"unknown status command {cmd!r}")
+        now = time.monotonic()
+        return {
+            "epoch": self.mgr.osdmap.epoch,
+            "daemons": {name: {"age": round(now - rep["stamp"], 1),
+                               "counters": rep.get("summary", {})}
+                        for name, rep in self.mgr.daemon_reports.items()},
+            "log_tail": self.mgr.log[-10:],
+        }
+
+
+class Mgr:
+    def __init__(self, name: str = "x",
+                 config: dict | None = None) -> None:
+        self.name = name
+        self.msgr = Messenger(f"mgr.{name}")
+        self.osdmap = OSDMap()
+        self.mon_addr: tuple[str, int] | None = None
+        self.config = {
+            "balancer_active": False,
+            "balancer_interval": 5.0,
+            "balancer_max_moves": 10,
+            "beacon_interval": 2.0,
+            **(config or {}),
+        }
+        # daemon name -> last report (DaemonStateIndex analog)
+        self.daemon_reports: dict[str, dict] = {}
+        self.log: list[str] = []
+        self.modules: dict[str, MgrModule] = {}
+        for cls in (BalancerModule, PgAutoscalerModule, StatusModule):
+            mod = cls(self)
+            self.modules[mod.name] = mod
+        self._tasks: list[asyncio.Task] = []
+        self._cmd_waiters: dict[int, asyncio.Future] = {}
+        self._tid = 0
+        self.msgr.add_dispatcher(self._dispatch)
+
+    async def start(self, mon_addr: tuple[str, int],
+                    host: str = "127.0.0.1", port: int = 0):
+        self.mon_addr = tuple(mon_addr)
+        addr = await self.msgr.bind(host, port)
+        await self._beacon()
+        await self._refresh_map()
+        self._tasks = [asyncio.ensure_future(self._beacon_loop())]
+        self._tasks += [asyncio.ensure_future(m.serve())
+                        for m in self.modules.values()]
+        return addr
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        # let cancellations land before the messenger goes away, or a
+        # module mid-send races the teardown
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.msgr.shutdown()
+
+    # -- mon session --------------------------------------------------------
+    async def _beacon(self) -> None:
+        try:
+            await self.msgr.send(self.mon_addr, "mon.0", Message(
+                "mgr_beacon", {"name": self.name,
+                               "addr": list(self.msgr.addr)}))
+        except (ConnectionError, OSError):
+            pass
+
+    async def _beacon_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.config["beacon_interval"])
+                await self._beacon()
+        except asyncio.CancelledError:
+            pass
+
+    async def _refresh_map(self) -> None:
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def d(conn, msg):
+            if msg.type == "osdmap_full":
+                await q.put(msg.data["map"])
+
+        self.msgr.add_dispatcher(d)
+        try:
+            await self.msgr.send(self.mon_addr, "mon.0",
+                                 Message("sub_osdmap", {}))
+            self.osdmap = OSDMap.from_dict(
+                await asyncio.wait_for(q.get(), 10))
+        finally:
+            self.msgr.dispatchers.remove(d)
+
+    async def mon_command(self, cmd: str, args: dict | None = None):
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_event_loop().create_future()
+        self._cmd_waiters[tid] = fut
+        try:
+            await self.msgr.send(self.mon_addr, "mon.0", Message(
+                "mon_command", {"cmd": cmd, "args": args or {},
+                                "tid": tid}))
+            data = await asyncio.wait_for(fut, 15)
+        finally:
+            self._cmd_waiters.pop(tid, None)
+        if not data.get("ok"):
+            raise RuntimeError(data.get("error"))
+        return data["result"]
+
+    # -- dispatch -----------------------------------------------------------
+    async def _dispatch(self, conn, msg: Message) -> None:
+        if msg.type == "osdmap_inc":
+            inc = Incremental.from_dict(msg.data["inc"])
+            if inc.epoch == self.osdmap.epoch + 1:
+                self.osdmap.apply_incremental(inc)
+            elif inc.epoch > self.osdmap.epoch:
+                t = asyncio.ensure_future(self._refresh_map())
+                self._tasks.append(t)
+        elif msg.type == "mon_command_reply":
+            fut = self._cmd_waiters.get(msg.data.get("tid"))
+            if fut is not None and not fut.done():
+                fut.set_result(msg.data)
+        elif msg.type == "mgr_report":
+            # DaemonServer: daemons push perf summaries
+            self.daemon_reports[msg.data["daemon"]] = {
+                "stamp": time.monotonic(),
+                "summary": msg.data.get("summary", {}),
+            }
+            await conn.send(Message("mgr_report_ack", {}))
+        elif msg.type == "mgr_command":
+            await self._handle_mgr_command(conn, msg)
+
+    async def _handle_mgr_command(self, conn, msg: Message) -> None:
+        prefix = msg.data.get("prefix", "")
+        args = msg.data.get("args", {})
+        parts = prefix.split(None, 1)
+        try:
+            mod = self.modules.get(parts[0]) if parts else None
+            if mod is None:
+                raise ValueError(f"no mgr module {parts[:1]}")
+            result = await mod.handle_command(
+                parts[1] if len(parts) > 1 else "", args)
+            await conn.send(Message("mgr_command_reply",
+                                    {"ok": True, "result": result,
+                                     "tid": msg.data.get("tid")}))
+        except Exception as e:
+            await conn.send(Message("mgr_command_reply",
+                                    {"ok": False, "error": str(e),
+                                     "tid": msg.data.get("tid")}))

@@ -651,10 +651,29 @@ class Monitor:
                                  "tid": msg.data.get("tid"),
                                  "epoch": self.osdmap.epoch}))
 
+    async def _h_mgr_beacon(self, conn, msg) -> None:
+        """Track the active mgr and publish its address to subscribers
+        (the MgrMap analog; MgrMonitor::prepare_beacon)."""
+        addr = tuple(msg.data["addr"])
+        changed = getattr(self, "mgr_addr", None) != addr
+        self.mgr_addr = addr
+        self.mgr_name = msg.data.get("name", "")
+        if changed:
+            payload = {"name": self.mgr_name, "addr": list(addr)}
+            for name, sub in list(self.subscribers.items()):
+                try:
+                    await sub.send(Message("mgr_map", payload))
+                except (ConnectionError, OSError):
+                    self.subscribers.pop(name, None)
+
     async def _h_sub_osdmap(self, conn, msg) -> None:
         self.subscribers[msg.from_name] = conn
         await conn.send(Message("osdmap_full",
                                 {"map": self.osdmap.to_dict()}))
+        if getattr(self, "mgr_addr", None):   # late joiners learn the mgr
+            await conn.send(Message("mgr_map",
+                                    {"name": self.mgr_name,
+                                     "addr": list(self.mgr_addr)}))
 
     async def _h_get_osdmap(self, conn, msg) -> None:
         since = msg.data.get("since", 0)

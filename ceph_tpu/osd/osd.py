@@ -471,6 +471,11 @@ class OSD:
             self._last_map_time = now          # one probe per window
             t = asyncio.ensure_future(self._catch_up_maps())
             self._tasks.append(t)
+        # mgr perf reporting rides the same cadence (MgrClient reports)
+        if now - getattr(self, "_last_mgr_report", 0.0) > 2.0:
+            self._last_mgr_report = now
+            t = asyncio.ensure_future(self._report_to_mgr())
+            self._tasks.append(t)
         # opportunistic re-kicks: a recovery push/pull that raced a peer
         # reboot backs off (the tick restarts it); a peering task that
         # died leaves the PG stranded (the tick re-runs it)
@@ -519,6 +524,43 @@ class OSD:
         await conn.send(Message("osd_ping_reply",
                                 {"from_osd": self.whoami,
                                  "stamp": msg.data["stamp"]}))
+
+    async def _h_mgr_map(self, conn, msg) -> None:
+        self._mgr_addr = tuple(msg.data["addr"])
+        self._mgr_name = msg.data.get("name", "0")
+
+    async def _report_to_mgr(self) -> None:
+        """Push a perf summary to the active mgr (the MgrClient report
+        protocol the DaemonServer aggregates)."""
+        addr = getattr(self, "_mgr_addr", None)
+        if addr is None:
+            return
+        summary = {}
+        try:
+            dump = self.perf.dump().get("osd", {})
+            for key in ("op", "op_w", "op_r", "op_in_bytes",
+                        "op_out_bytes", "subop_w", "recovery_ops"):
+                if key in dump:
+                    v = dump[key]
+                    summary[key] = v.get("value", v) \
+                        if isinstance(v, dict) else v
+            summary["num_pgs"] = len(self.pgs)
+        except Exception:
+            return
+        try:
+            await asyncio.wait_for(self.msgr.send(
+                addr, f"mgr.{getattr(self, '_mgr_name', '0')}",
+                Message("mgr_report",
+                        {"daemon": f"osd.{self.whoami}",
+                         "summary": summary})), 2.0)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            # keep the address: a transient stall must not silence
+            # reporting forever (the mon only re-publishes mgr_map on
+            # CHANGE); the next cadence simply retries
+            pass
+
+    async def _h_mgr_report_ack(self, conn, msg) -> None:
+        pass
 
     async def _h_watch_notify_ack(self, conn, msg) -> None:
         fut = self._notify_waiters.pop(msg.data.get("notify_id"), None)

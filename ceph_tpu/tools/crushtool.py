@@ -262,7 +262,7 @@ def decompile(cm: CrushMap, type_names: dict[int, str] | None = None,
         out.append(f"\thash {b.hash}\t# rjenkins1")
         for item, w in zip(b.items, b.item_weights):
             iname = f"osd.{item}" if item >= 0 else bname(item)
-            out.append(f"\titem {iname} weight {w / 0x10000:.3f}")
+            out.append(f"\titem {iname} weight {w / 0x10000:.5f}")
         out.append("}")
 
     for b in cm.buckets.values():

@@ -49,7 +49,14 @@ async def run_cluster(args) -> None:
         print(f"osd.{wid} up ({'db' if args.store_dir else 'mem'} store, "
               f"host{i % args.hosts})", flush=True)
         osds.append(osd)
-    print(f"cluster ready: 1 mon, {len(osds)} osds -- "
+    mgr = None
+    if args.mgr:
+        from ..mgr import Mgr
+        mgr = Mgr(config={"balancer_active": True})
+        await mgr.start(addr)
+        print("mgr.x active (balancer on)", flush=True)
+    print(f"cluster ready: 1 mon, {len(osds)} osds"
+          f"{', 1 mgr' if mgr else ''} -- "
           f"rados -m {addr[0]}:{addr[1]} lspools", flush=True)
 
     stop = asyncio.Event()
@@ -58,6 +65,8 @@ async def run_cluster(args) -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     print("shutting down...", flush=True)
+    if mgr is not None:
+        await mgr.stop()
     for osd in osds:
         await osd.stop()
     await mon.stop()
@@ -74,6 +83,9 @@ def main(argv=None) -> int:
     p.add_argument("--asok-dir", default=None,
                    help="directory for admin sockets (default store-dir)")
     p.add_argument("--min-down-reporters", type=int, default=2)
+    p.add_argument("--mgr", action="store_true", default=True,
+                   help="start a mgr daemon (balancer active)")
+    p.add_argument("--no-mgr", dest="mgr", action="store_false")
     args = p.parse_args(argv)
     if args.store_dir:
         os.makedirs(args.store_dir, exist_ok=True)

@@ -146,3 +146,11 @@ def test_cli_roundtrip(tmp_path):
         capture_output=True, text=True, cwd="/root/repo")
     assert r.returncode == 0, r.stderr
     assert "CRUSH rule 0 x 15" in r.stdout
+
+
+def test_roundtrip_preserves_fixed_point_weights():
+    """%.5f keeps 1/0x10000 weight granularity (review regression)."""
+    cm, tn, dev = compile_text(MAP_TEXT)
+    cm.buckets[-2].item_weights[0] = 65569      # 1.0005035...
+    cm2, _, _ = compile_text(decompile(cm, tn, dev))
+    assert cm2.buckets[-2].item_weights[0] == 65569

@@ -1,0 +1,119 @@
+"""Mgr daemon: beacon/MgrMap publication, daemon report aggregation,
+module host with commands, active balancer loop (src/mgr semantics)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.mgr import Mgr
+from ceph_tpu.msg import Message, Messenger
+
+from test_client import make_cluster, teardown, run
+
+
+async def wait_for(cond, timeout=30.0, msg="condition"):
+    for _ in range(int(timeout / 0.2)):
+        if cond():
+            return
+        await asyncio.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+async def mgr_command(client, addr, prefix, args=None):
+    q = asyncio.Queue()
+
+    async def d(conn, msg):
+        if msg.type == "mgr_command_reply":
+            await q.put(msg.data)
+
+    client.add_dispatcher(d)
+    try:
+        await client.send(addr, "mgr.x",
+                          Message("mgr_command",
+                                  {"prefix": prefix, "args": args or {}}))
+        data = await asyncio.wait_for(q.get(), 10)
+    finally:
+        client.dispatchers.remove(d)
+    if not data["ok"]:
+        raise RuntimeError(data["error"])
+    return data["result"]
+
+
+def test_mgr_aggregates_daemon_reports_and_serves_modules():
+    async def main():
+        mon, osds = await make_cluster(3, osd_config={
+            "osd_heartbeat_interval": 0.2})
+        mgr = Mgr(config={"beacon_interval": 0.5})
+        addr = await mgr.start(mon.msgr.addr)
+        client = Messenger("client.mgr")
+        await client.bind()
+        try:
+            # OSDs learn the mgr from the mon and start reporting
+            await wait_for(lambda: len(mgr.daemon_reports) >= 3,
+                           msg="all osd reports aggregated")
+            assert {f"osd.{o.whoami}" for o in osds} <= \
+                set(mgr.daemon_reports)
+            st = await mgr_command(client, addr, "status show")
+            assert len(st["daemons"]) >= 3
+            # pg_autoscaler recommendations
+            from ceph_tpu.client import Rados
+            rados = await Rados(mon.msgr.addr).connect()
+            await rados.pool_create("rbd", pg_num=4)
+            await asyncio.sleep(0.5)
+            recs = await mgr_command(client, addr,
+                                     "pg_autoscaler status")
+            assert any(r["pool"] == "rbd" for r in recs)
+            bal = await mgr_command(client, addr, "balancer status")
+            assert bal["active"] is False
+            await rados.shutdown()
+        finally:
+            await client.shutdown()
+            await mgr.stop()
+            await teardown(mon, osds)
+    run(main())
+
+
+def test_mgr_active_balancer_flattens_skew():
+    async def main():
+        mon, osds = await make_cluster(5)
+        mgr = Mgr(config={"beacon_interval": 0.5,
+                          "balancer_interval": 0.5,
+                          "balancer_max_moves": 30})
+        addr = await mgr.start(mon.msgr.addr)
+        client = Messenger("client.bal")
+        await client.bind()
+        try:
+            from ceph_tpu.client import Rados
+            rados = await Rados(mon.msgr.addr).connect()
+            await rados.pool_create("rbd", pg_num=64)
+            # skew manually, then switch the balancer ON
+            m = mon.osdmap
+            pool_id = m.pool_names["rbd"]
+            skewed = 0
+            for ps in range(64):
+                if skewed >= 6:
+                    break
+                up, _ = m.pg_to_up_acting(pool_id, ps)
+                if 0 in up:
+                    continue
+                await rados.mon_command(
+                    "osd pg-upmap-items",
+                    {"pgid": m.pg_name(pool_id, ps),
+                     "mappings": [[up[-1], 0]]})
+                skewed += 1
+            from ceph_tpu.mgr.balancer import pg_distribution
+            before = pg_distribution(mon.osdmap)
+            assert before["max"] - before["min"] > 1
+            await mgr_command(client, addr, "balancer on")
+
+            def balanced():
+                d = pg_distribution(mon.osdmap)
+                return d["max"] - d["min"] <= 1
+            await wait_for(balanced, timeout=30,
+                           msg="active balancer flattened the skew")
+            await rados.shutdown()
+        finally:
+            await client.shutdown()
+            await mgr.stop()
+            await teardown(mon, osds)
+    run(main())

@@ -131,7 +131,8 @@ class IoCtx:
                   extra: dict | None = None,
                   timeout: float | None = None) -> tuple[dict, list]:
         snapc = getattr(self, "_snapc", None)
-        if snapc and any(o["op"] in _WRITE_OPS for o in ops):
+        if snapc and any(o["op"] in _WRITE_OPS or o["op"] == "call"
+                         for o in ops):
             extra = {**(extra or {}), "snapc": snapc}
         kwargs = {}
         if timeout is not None:
@@ -202,6 +203,25 @@ class IoCtx:
     async def list_watchers(self, oid: str) -> list:
         data, _ = await self._op(oid, [{"op": "list_watchers"}])
         return _check(data["results"])["watchers"]
+
+    # -- object classes (rados_exec / IoCtx::exec) --------------------------
+    async def exec(self, oid: str, cls: str, method: str,
+                   data: bytes = b"") -> bytes:
+        """Run a server-side cls method on the object; returns its
+        output bytes (rados_exec, src/librados/librados_c.cc)."""
+        reply, segs = await self._op(oid, [
+            {"op": "call", "cls": cls, "method": method, "data": data}])
+        r = _check(reply["results"])
+        return segs[r["seg"]] if "seg" in r else b""
+
+    def op_call(self, cls: str, method: str, data: bytes = b"") -> dict:
+        """A call op for composing into operate() vectors."""
+        return {"op": "call", "cls": cls, "method": method, "data": data}
+
+    async def operate(self, oid: str,
+                      ops: list[dict]) -> tuple[dict, list]:
+        """Atomic multi-op vector on one object (ObjectWriteOperation)."""
+        return await self._op(oid, ops)
 
     async def remove(self, oid: str) -> None:
         await self._op(oid, [{"op": "remove"}])

@@ -12,8 +12,26 @@ from .perf import PerfCounters, PerfCountersCollection
 from .admin_socket import AdminSocket
 from .log import Logger, log_context
 
+
+def make_task_tracker(tasks: list):
+    """Track a background task with a strong ref that self-prunes on
+    completion -- long-running daemons spawn periodic tasks and an
+    append-only list is an unbounded leak."""
+    def track(t):
+        tasks.append(t)
+
+        def _done(task, _tasks=tasks):
+            try:
+                _tasks.remove(task)
+            except ValueError:
+                pass
+        t.add_done_callback(_done)
+        return t
+    return track
+
+
 __all__ = [
     "Option", "ConfigProxy", "OPT_INT", "OPT_FLOAT", "OPT_STR",
     "OPT_BOOL", "PerfCounters", "PerfCountersCollection", "AdminSocket",
-    "Logger", "log_context",
+    "Logger", "log_context", "make_task_tracker",
 ]

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import time
 
+from ..common import make_task_tracker
 from ..msg import Message, Messenger
 from ..mon.osdmap import OSDMap, Incremental
 
@@ -141,6 +142,7 @@ class Mgr:
             mod = cls(self)
             self.modules[mod.name] = mod
         self._tasks: list[asyncio.Task] = []
+        self._track = make_task_tracker(self._tasks)
         self._cmd_waiters: dict[int, asyncio.Future] = {}
         self._tid = 0
         self.msgr.add_dispatcher(self._dispatch)
@@ -151,17 +153,18 @@ class Mgr:
         addr = await self.msgr.bind(host, port)
         await self._beacon()
         await self._refresh_map()
-        self._tasks = [asyncio.ensure_future(self._beacon_loop())]
+        self._tasks += [asyncio.ensure_future(self._beacon_loop())]
         self._tasks += [asyncio.ensure_future(m.serve())
                         for m in self.modules.values()]
         return addr
 
     async def stop(self) -> None:
-        for t in self._tasks:
+        pending = list(self._tasks)
+        for t in pending:
             t.cancel()
         # let cancellations land before the messenger goes away, or a
         # module mid-send races the teardown
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await asyncio.gather(*pending, return_exceptions=True)
         await self.msgr.shutdown()
 
     # -- mon session --------------------------------------------------------
@@ -220,8 +223,7 @@ class Mgr:
             if inc.epoch == self.osdmap.epoch + 1:
                 self.osdmap.apply_incremental(inc)
             elif inc.epoch > self.osdmap.epoch:
-                t = asyncio.ensure_future(self._refresh_map())
-                self._tasks.append(t)
+                self._track(asyncio.ensure_future(self._refresh_map()))
         elif msg.type == "mon_command_reply":
             fut = self._cmd_waiters.get(msg.data.get("tid"))
             if fut is not None and not fut.done():

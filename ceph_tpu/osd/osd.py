@@ -14,7 +14,8 @@ import itertools
 import time
 import uuid as uuid_mod
 
-from ..common import AdminSocket, ConfigProxy, PerfCountersCollection
+from ..common import AdminSocket, ConfigProxy, PerfCountersCollection, \
+    make_task_tracker
 from ..mon.osdmap import OSDMap, Incremental
 from ..msg import Message, Messenger
 from ..os.store import MemStore
@@ -69,6 +70,7 @@ class OSD:
         self._hb_last: dict[int, float] = {}
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
+        self._track = make_task_tracker(self._tasks)
         self._rebooting = False
         # observability (src/common/perf_counters + TrackedOp analog)
         self.perf = PerfCountersCollection()
@@ -158,7 +160,7 @@ class OSD:
         self._stopped = True
         if self.admin_socket is not None:
             await self.admin_socket.stop()
-        for t in self._tasks:
+        for t in list(self._tasks):
             t.cancel()
         for pg in self.pgs.values():
             if pg._recovery_task:
@@ -237,7 +239,7 @@ class OSD:
         if inc.epoch <= self.osdmap.epoch:
             return          # duplicate delivery (multi-mon subscriptions)
         if inc.epoch != self.osdmap.epoch + 1:
-            asyncio.ensure_future(self._catch_up_maps())
+            self._track(asyncio.ensure_future(self._catch_up_maps()))
             return
         self.osdmap.apply_incremental(inc)
         self._on_map_change()
@@ -301,8 +303,7 @@ class OSD:
         if (me is not None and not me.up and not self._stopped
                 and not self._rebooting):
             self._rebooting = True
-            t = asyncio.ensure_future(self._reboot())
-            self._tasks.append(t)
+            self._track(asyncio.ensure_future(self._reboot()))
 
     async def _reboot(self) -> None:
         try:
@@ -352,8 +353,7 @@ class OSD:
                     reply_type="osd_pg_temp_reply", timeout=10)
             except (asyncio.TimeoutError, ConnectionError, OSError):
                 pass                 # re-requested on the next peering
-        t = asyncio.ensure_future(_send())
-        self._tasks.append(t)
+        self._track(asyncio.ensure_future(_send()))
 
     # -- peer RPC -----------------------------------------------------------
     def _peer_addr(self, osd: int) -> tuple[str, int]:
@@ -469,13 +469,11 @@ class OSD:
         # re-hunts on session loss the same way)
         if now - getattr(self, "_last_map_time", now) > 5.0:
             self._last_map_time = now          # one probe per window
-            t = asyncio.ensure_future(self._catch_up_maps())
-            self._tasks.append(t)
+            self._track(asyncio.ensure_future(self._catch_up_maps()))
         # mgr perf reporting rides the same cadence (MgrClient reports)
         if now - getattr(self, "_last_mgr_report", 0.0) > 2.0:
             self._last_mgr_report = now
-            t = asyncio.ensure_future(self._report_to_mgr())
-            self._tasks.append(t)
+            self._track(asyncio.ensure_future(self._report_to_mgr()))
         # opportunistic re-kicks: a recovery push/pull that raced a peer
         # reboot backs off (the tick restarts it); a peering task that
         # died leaves the PG stranded (the tick re-runs it)

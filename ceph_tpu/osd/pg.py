@@ -35,6 +35,7 @@ WRITE_OPS = {"create", "write", "writefull", "append", "truncate", "zero",
              "omap_clear"}
 READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get", "list"}
 WATCH_OPS = {"watch", "unwatch", "notify", "list_watchers", "list_snaps"}
+CALL_OPS = {"call"}     # cls method execution (CEPH_OSD_OP_CALL)
 
 
 class PG:
@@ -566,6 +567,39 @@ class PG:
                 elif name in WATCH_OPS:
                     r = await self._do_watch_op(oid, op, msg, conn)
                     results.append(r)
+                elif name in CALL_OPS:
+                    # cls method: runs against the overlay so it reads
+                    # earlier ops in the vector and its writes join the
+                    # same atomic commit (ClassHandler / do_osd_ops CALL)
+                    from . import cls as cls_mod
+                    if overlay is None:
+                        overlay = await self._make_overlay(read_oid)
+                    if applied < len(writes):
+                        self._apply_overlay(overlay, writes[applied:])
+                        applied = len(writes)
+                    try:
+                        out = cls_mod.call(
+                            self, oid, overlay, writes,
+                            msg.from_name or "?", op.get("cls", ""),
+                            op.get("method", ""), op.get("data", b""),
+                            read_only_ctx=bool(snapid))
+                        applied = len(writes)   # hctx applied its own
+                        r = {"ok": True}
+                        if out:
+                            r["seg"] = len(segments)
+                            segments.append(out)
+                        results.append(r)
+                    except cls_mod.ClsError as e:
+                        # a failed cls method aborts the whole vector
+                        # (negative return from the class method)
+                        return ({"err": e.errno_name,
+                                 "detail": e.detail}, [])
+                    except Exception as e:
+                        # malformed indata etc. must produce a reply,
+                        # not a dead op the client retries to timeout
+                        return ({"err": "EINVAL",
+                                 "detail": f"cls: {type(e).__name__}: "
+                                           f"{e}"}, [])
                 else:
                     results.append({"err": f"EOPNOTSUPP {name}"})
             if writes:

@@ -84,7 +84,8 @@ def main(argv=None) -> int:
                    help="directory for admin sockets (default store-dir)")
     p.add_argument("--min-down-reporters", type=int, default=2)
     p.add_argument("--mgr", action="store_true", default=True,
-                   help="start a mgr daemon (balancer active)")
+                   help="start a mgr daemon (balancer active; on by "
+                        "default, disable with --no-mgr)")
     p.add_argument("--no-mgr", dest="mgr", action="store_false")
     args = p.parse_args(argv)
     if args.store_dir:

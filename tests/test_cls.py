@@ -1,0 +1,142 @@
+"""Object classes (cls): server-side methods via IoCtx.exec
+(ClassHandler.cc, src/cls/{lock,refcount,version}, objclass API)."""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.client import Rados, RadosError
+
+from test_client import make_cluster, teardown, run
+
+
+def test_cls_lock_exclusive_shared_break():
+    async def main():
+        mon, osds = await make_cluster(3)
+        r1 = await Rados(mon.msgr.addr, name="client.a").connect()
+        r2 = await Rados(mon.msgr.addr, name="client.b").connect()
+        try:
+            await r1.pool_create("p", pg_num=4)
+            io1 = await r1.open_ioctx("p")
+            io2 = await r2.open_ioctx("p")
+            lk = json.dumps({"name": "l", "type": "exclusive",
+                             "cookie": "c1"}).encode()
+            await io1.exec("obj", "lock", "lock", lk)
+            # second exclusive locker bounces
+            lk2 = json.dumps({"name": "l", "type": "exclusive",
+                              "cookie": "c2"}).encode()
+            with pytest.raises(RadosError) as ei:
+                await io2.exec("obj", "lock", "lock", lk2)
+            assert "EBUSY" in str(ei.value)
+            # get_info sees the holder
+            info = json.loads(await io2.exec(
+                "obj", "lock", "get_info",
+                json.dumps({"name": "l"}).encode()))
+            assert info["type"] == "exclusive"
+            assert info["lockers"][0]["entity"] == "client.a"
+            # assert_locked composes into a write vector: holder wins,
+            # non-holder's whole vector aborts atomically
+            await io1.operate("obj", [
+                io1.op_call("lock", "assert_locked",
+                            json.dumps({"name": "l",
+                                        "cookie": "c1"}).encode()),
+                {"op": "writefull", "data": b"held"}])
+            with pytest.raises(RadosError):
+                await io2.operate("obj", [
+                    io2.op_call("lock", "assert_locked",
+                                json.dumps({"name": "l",
+                                            "cookie": "c2"}).encode()),
+                    {"op": "writefull", "data": b"stolen"}])
+            assert await io1.read("obj") == b"held"
+            # break_lock lets client.b evict a dead client.a
+            await io2.exec("obj", "lock", "break_lock", json.dumps(
+                {"name": "l", "locker": "client.a",
+                 "cookie": "c1"}).encode())
+            await io2.exec("obj", "lock", "lock", lk2)
+            # shared locks coexist
+            for io, ck in ((io1, "s1"), (io2, "s2")):
+                await io.exec("obj", "lock", "lock", json.dumps(
+                    {"name": "shr", "type": "shared",
+                     "cookie": ck}).encode())
+            names = json.loads(await io1.exec("obj", "lock",
+                                              "list_locks", b""))
+            assert names == ["l", "shr"]
+        finally:
+            await teardown(mon, osds, r1)
+            await r2.shutdown()
+    run(main())
+
+
+def test_cls_refcount_and_version():
+    async def main():
+        mon, osds = await make_cluster(3)
+        rados = await Rados(mon.msgr.addr).connect()
+        try:
+            await rados.pool_create("p", pg_num=4)
+            io = await rados.open_ioctx("p")
+            await io.write_full("tail", b"shared-tail-bytes")
+            for tag in ("copy1", "copy2"):
+                await io.exec("tail", "refcount", "get",
+                              json.dumps({"tag": tag}).encode())
+            await io.exec("tail", "refcount", "put",
+                          json.dumps({"tag": "copy1"}).encode())
+            assert json.loads(await io.exec(
+                "tail", "refcount", "list", b"")) == ["copy2"]
+            assert await io.read("tail") == b"shared-tail-bytes"
+            # last put removes the object server-side
+            await io.exec("tail", "refcount", "put",
+                          json.dumps({"tag": "copy2"}).encode())
+            with pytest.raises(RadosError):
+                await io.stat("tail")
+
+            # cls_version optimistic concurrency
+            await io.write_full("meta", b"{}")
+            await io.exec("meta", "version", "inc", b"")
+            v = json.loads(await io.exec("meta", "version", "read", b""))
+            assert v["ver"] == 1
+            await io.exec("meta", "version", "inc_conds",
+                          json.dumps(v).encode())
+            # stale (ver, tag) is rejected: the writer must re-read
+            with pytest.raises(RadosError) as ei:
+                await io.exec("meta", "version", "inc_conds",
+                              json.dumps(v).encode())
+            assert "ECANCELED" in str(ei.value)
+        finally:
+            await teardown(mon, osds, rados)
+    run(main())
+
+
+def test_cls_atomic_with_vector_and_failure():
+    async def main():
+        mon, osds = await make_cluster(3)
+        rados = await Rados(mon.msgr.addr).connect()
+        try:
+            await rados.pool_create("p", pg_num=4)
+            io = await rados.open_ioctx("p")
+            # cls method reads bytes written EARLIER IN THE SAME vector
+            reply, segs = await io.operate("obj", [
+                {"op": "writefull", "data": b"payload"},
+                io.op_call("version", "inc", b""),
+                {"op": "read", "off": 0, "len": None},
+            ])
+            r = reply["results"][2]
+            assert segs[r["seg"]] == b"payload"
+            v = json.loads(await io.exec("obj", "version", "read", b""))
+            assert v["ver"] == 1
+            # a failing cls method aborts the whole vector: the write
+            # before it must NOT land
+            with pytest.raises(RadosError):
+                await io.operate("obj", [
+                    {"op": "writefull", "data": b"MUST-NOT-LAND"},
+                    io.op_call("version", "check_conds",
+                               json.dumps({"ver": 999,
+                                           "tag": "x"}).encode()),
+                ])
+            assert await io.read("obj") == b"payload"
+            # unknown class / method
+            with pytest.raises(RadosError):
+                await io.exec("obj", "nope", "nope", b"")
+        finally:
+            await teardown(mon, osds, rados)
+    run(main())

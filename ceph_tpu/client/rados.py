@@ -11,7 +11,7 @@ from __future__ import annotations
 import asyncio
 
 from .objecter import Objecter, ObjecterError
-from ..osd.pg import WRITE_OPS as _WRITE_OPS   # ops carrying the snapc
+from ..osd.pg import CALL_OPS as _CALL_OPS, WRITE_OPS as _WRITE_OPS
 
 
 class RadosError(Exception):
@@ -131,7 +131,7 @@ class IoCtx:
                   extra: dict | None = None,
                   timeout: float | None = None) -> tuple[dict, list]:
         snapc = getattr(self, "_snapc", None)
-        if snapc and any(o["op"] in _WRITE_OPS or o["op"] == "call"
+        if snapc and any(o["op"] in _WRITE_OPS or o["op"] in _CALL_OPS
                          for o in ops):
             extra = {**(extra or {}), "snapc": snapc}
         kwargs = {}
@@ -228,6 +228,9 @@ class IoCtx:
 
     async def truncate(self, oid: str, size: int) -> None:
         await self._op(oid, [{"op": "truncate", "size": size}])
+
+    async def zero(self, oid: str, off: int, length: int) -> None:
+        await self._op(oid, [{"op": "zero", "off": off, "len": length}])
 
     async def stat(self, oid: str) -> dict:
         data, _ = await self._op(oid, [{"op": "stat"}])

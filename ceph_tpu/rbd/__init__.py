@@ -1,0 +1,9 @@
+"""librbd analog: block images on RADOS.
+
+Reference: src/librbd (io path ImageRequest.cc -> ObjectRequest ->
+Objecter; metadata via cls_rbd).  See rbd.py.
+"""
+
+from .rbd import RBD, Image, RbdError
+
+__all__ = ["RBD", "Image", "RbdError"]

@@ -1,0 +1,633 @@
+"""Block images on RADOS (librbd analog).
+
+Layout follows rbd format 2 (src/librbd/image/CreateRequest.cc):
+
+    rbd_directory                  pool-wide name <-> id registry (omap)
+    rbd_children                   parent(pool,image,snap) -> child ids
+    rbd_header.<id>                image metadata omap (cls_rbd methods)
+    rbd_data.<id>.<objectno:016x>  data objects, 2^order bytes each
+
+The I/O path mirrors src/librbd/io/ImageRequest.cc: an image extent is
+cut into per-object extents (the striper's map_extents with
+su=2^order, sc=1 by default; fancy striping supported), object ops are
+issued concurrently through the objecter, and clone reads fall back to
+the parent snapshot within the overlap (ObjectReadRequest's copyup
+path, src/librbd/io/CopyupRequest.cc does the write-side copyup).
+
+Image snapshots ARE RADOS self-managed snapshots: snap ids come from
+the pool (librbd takes them from the mon the same way), the header's
+snap table (cls_rbd get_snapcontext) provides the write snap context,
+and snap reads pass the snap id down the rados read
+(src/librbd/Operations.cc snap_create -> cls_rbd snapshot_add).
+
+Exclusive-lock feature: a cls_lock exclusive lock on the header with
+periodic renewal (ManagedLock.cc semantics without the blacklist --
+expiry substitutes for blocklisting a dead holder).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+from ..client.rados import IoCtx, RadosError
+from ..client.striper import Layout, map_extents
+
+RBD_DIRECTORY = "rbd_directory"
+RBD_CHILDREN = "rbd_children"
+LOCK_NAME = "rbd_lock"
+LOCK_RENEW_S = 10.0
+LOCK_DURATION_S = 30.0
+
+
+class RbdError(Exception):
+    def __init__(self, errno_name: str, detail: str = "") -> None:
+        super().__init__(f"{errno_name}{': ' + detail if detail else ''}")
+        self.errno_name = errno_name
+
+
+def _wrap(e: RadosError) -> RbdError:
+    return RbdError(e.errno_name, str(e))
+
+
+def _header(iid: str) -> str:
+    return f"rbd_header.{iid}"
+
+
+class RBD:
+    """Image management entry points (librbd.h rbd_create/list/remove)."""
+
+    async def create(self, ioctx, name: str, size: int, order: int = 22,
+                     stripe_unit: int | None = None,
+                     stripe_count: int = 1) -> str:
+        iid = os.urandom(8).hex()
+        try:
+            await ioctx.exec(RBD_DIRECTORY, "rbd", "dir_add_image",
+                             json.dumps({"name": name,
+                                         "id": iid}).encode())
+        except RadosError as e:
+            raise _wrap(e) from e
+        try:
+            await ioctx.exec(_header(iid), "rbd", "create", json.dumps({
+                "size": int(size), "order": order,
+                "object_prefix": f"rbd_data.{iid}",
+                "stripe_unit": stripe_unit or (1 << order),
+                "stripe_count": stripe_count}).encode())
+        except RadosError as e:
+            # roll the directory entry back so a failed create does not
+            # leave a dangling name
+            await ioctx.exec(RBD_DIRECTORY, "rbd", "dir_remove_image",
+                             json.dumps({"name": name}).encode())
+            raise _wrap(e) from e
+        return iid
+
+    async def list(self, ioctx) -> list[str]:
+        try:
+            out = await ioctx.exec(RBD_DIRECTORY, "rbd", "dir_list", b"")
+        except RadosError as e:
+            if e.errno_name == "ENOENT":
+                return []
+            raise _wrap(e) from e
+        return sorted(json.loads(out))
+
+    async def remove(self, ioctx, name: str) -> None:
+        img = await Image.open(ioctx, name, read_only=True)
+        try:
+            if any(s.get("protected") for s in img.meta["snapshots"]):
+                raise RbdError("EBUSY", "image has protected snapshots")
+            if img.meta["snapshots"]:
+                raise RbdError("ENOTEMPTY",
+                               "image has snapshots (remove them first)")
+            if img.meta.get("parent"):
+                p = img.meta["parent"]
+                await ioctx.exec(RBD_CHILDREN, "rbd", "remove_child",
+                                 json.dumps({**p,
+                                             "child_id": img.id}).encode())
+            n_objs = img._object_count(img.meta["size"])
+            await _gather_bounded(
+                [img._remove_data_obj(i) for i in range(n_objs)])
+        finally:
+            await img.close()
+        try:
+            await ioctx.remove(_header(img.id))
+            await ioctx.exec(RBD_DIRECTORY, "rbd", "dir_remove_image",
+                             json.dumps({"name": name}).encode())
+        except RadosError as e:
+            raise _wrap(e) from e
+
+    async def clone(self, parent_ioctx, parent_name: str,
+                    snap_name: str, child_ioctx, child_name: str,
+                    order: int | None = None) -> str:
+        """COW clone of a PROTECTED parent snapshot
+        (librbd::clone, src/librbd/image/CloneRequest.cc)."""
+        p = await Image.open(parent_ioctx, parent_name, read_only=True)
+        try:
+            snap = p._snap_by_name(snap_name)
+            if not snap.get("protected"):
+                raise RbdError("EINVAL", "parent snap is not protected")
+            child_order = order or p.meta["order"]
+            iid = await self.create(child_ioctx, child_name,
+                                    snap["size"], order=child_order)
+            await child_ioctx.exec(
+                _header(iid), "rbd", "set_parent", json.dumps({
+                    "pool_id": parent_ioctx.pool_id, "image_id": p.id,
+                    "snap_id": snap["id"],
+                    "overlap": snap["size"]}).encode())
+            await parent_ioctx.exec(
+                RBD_CHILDREN, "rbd", "add_child", json.dumps({
+                    "pool_id": parent_ioctx.pool_id, "image_id": p.id,
+                    "snap_id": snap["id"], "child_id": iid}).encode())
+            return iid
+        except RadosError as e:
+            raise _wrap(e) from e
+        finally:
+            await p.close()
+
+
+async def _gather_bounded(coros, limit: int = 16):
+    """Bounded-concurrency gather: image-wide sweeps (remove, flatten,
+    rollback) touch every object and would otherwise flood the cluster
+    with one op per object at once."""
+    sem = asyncio.Semaphore(limit)
+
+    async def one(c):
+        async with sem:
+            return await c
+    return await asyncio.gather(*(one(c) for c in coros))
+
+
+class Image:
+    """An open image handle (librbd::Image).
+
+    Use ``await Image.open(ioctx, name)``; close() releases the
+    exclusive lock and stops its renewal.
+    """
+
+    def __init__(self, ioctx, name: str, iid: str, meta: dict,
+                 read_only: bool, snap_id: int | None) -> None:
+        self.ioctx = ioctx
+        self.name = name
+        self.id = iid
+        self.meta = meta
+        self.read_only = read_only
+        self.snap_id = snap_id
+        self._cookie = os.urandom(4).hex()
+        self._renew_task: asyncio.Task | None = None
+        self._parent: Image | None = None
+        self._closed = False
+
+    # -- open/close ---------------------------------------------------------
+    @staticmethod
+    async def open(ioctx, name: str, snapshot: str | None = None,
+                   read_only: bool = False) -> "Image":
+        try:
+            iid = (await ioctx.exec(
+                RBD_DIRECTORY, "rbd", "dir_get_id",
+                json.dumps({"name": name}).encode())).decode()
+            meta = json.loads(await ioctx.exec(
+                _header(iid), "rbd", "get_image_meta", b""))
+        except RadosError as e:
+            raise _wrap(e) from e
+        # every image gets a PRIVATE ioctx: the snap context installed
+        # by _refresh_snapc is per-ioctx state, and a second image
+        # opened on a shared ioctx would clobber the first image's
+        # write snapc (silently skipping COW for its snapshots)
+        ioctx = IoCtx(ioctx.rados, ioctx.pool_name, ioctx.pool_id)
+        snap_id = None
+        img = Image(ioctx, name, iid, meta, read_only or bool(snapshot),
+                    snap_id)
+        if snapshot is not None:
+            img.snap_id = img._snap_by_name(snapshot)["id"]
+        if not img.read_only:
+            await img._acquire_lock()
+        await img._refresh_snapc()
+        return img
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._renew_task:
+            self._renew_task.cancel()
+            try:
+                await self._renew_task
+            except asyncio.CancelledError:
+                pass
+        if not self.read_only:
+            try:
+                await self.ioctx.exec(
+                    _header(self.id), "lock", "unlock", json.dumps({
+                        "name": LOCK_NAME,
+                        "cookie": self._cookie}).encode())
+            except RadosError:
+                pass
+        if self._parent is not None:
+            await self._parent.close()
+            self._parent = None
+
+    # -- exclusive lock (ManagedLock / cls_lock) ----------------------------
+    async def _acquire_lock(self) -> None:
+        try:
+            await self.ioctx.exec(
+                _header(self.id), "lock", "lock", json.dumps({
+                    "name": LOCK_NAME, "type": "exclusive",
+                    "cookie": self._cookie,
+                    "duration": LOCK_DURATION_S,
+                    "flags": 1}).encode())       # MAY_RENEW
+        except RadosError as e:
+            raise RbdError("EBUSY" if e.errno_name == "EBUSY"
+                           else e.errno_name,
+                           "image is locked by another client") from e
+        self._renew_task = asyncio.ensure_future(self._renew_loop())
+
+    async def _renew_loop(self) -> None:
+        while True:
+            await asyncio.sleep(LOCK_RENEW_S)
+            try:
+                await self.ioctx.exec(
+                    _header(self.id), "lock", "lock", json.dumps({
+                        "name": LOCK_NAME, "type": "exclusive",
+                        "cookie": self._cookie,
+                        "duration": LOCK_DURATION_S,
+                        "flags": 1}).encode())
+            except (RadosError, ConnectionError, OSError):
+                pass                  # retried next period; expiry wins
+
+    @staticmethod
+    async def break_lock(ioctx, name: str) -> None:
+        """Evict a dead client's exclusive lock (rbd lock break)."""
+        iid = (await ioctx.exec(RBD_DIRECTORY, "rbd", "dir_get_id",
+                                json.dumps({"name": name}).encode())
+               ).decode()
+        info = json.loads(await ioctx.exec(
+            _header(iid), "lock", "get_info",
+            json.dumps({"name": LOCK_NAME}).encode()))
+        for lk in info["lockers"]:
+            await ioctx.exec(_header(iid), "lock", "break_lock",
+                             json.dumps({"name": LOCK_NAME,
+                                         "locker": lk["entity"],
+                                         "cookie": lk["cookie"]}).encode())
+
+    # -- geometry -----------------------------------------------------------
+    @property
+    def _layout(self) -> Layout:
+        osz = 1 << self.meta["order"]
+        return Layout(stripe_unit=self.meta.get("stripe_unit", osz),
+                      stripe_count=self.meta.get("stripe_count", 1),
+                      object_size=osz)
+
+    def _data_obj(self, objectno: int) -> str:
+        return f"{self.meta['object_prefix']}.{objectno:016x}"
+
+    def _object_count(self, size: int) -> int:
+        if size == 0:
+            return 0
+        return max(e[0] for e in map_extents(self._layout, 0, size)) + 1
+
+    def _snap_by_name(self, snap_name: str) -> dict:
+        for s in self.meta["snapshots"]:
+            if s["name"] == snap_name:
+                return s
+        raise RbdError("ENOENT", f"no snapshot {snap_name}")
+
+    async def _refresh_meta(self) -> None:
+        self.meta = json.loads(await self.ioctx.exec(
+            _header(self.id), "rbd", "get_image_meta", b""))
+
+    async def _refresh_snapc(self) -> None:
+        """Install the image's snap context on the data ioctx so every
+        write COWs against the image's snapshots."""
+        snapc = json.loads(await self.ioctx.exec(
+            _header(self.id), "rbd", "get_snapcontext", b""))
+        self.ioctx.set_snap_context(snapc["seq"], snapc["snaps"])
+
+    async def size(self) -> int:
+        if self.snap_id is not None:
+            for s in self.meta["snapshots"]:
+                if s["id"] == self.snap_id:
+                    return s["size"]
+        return self.meta["size"]
+
+    def stat(self) -> dict:
+        return {"size": self.meta["size"], "order": self.meta["order"],
+                "id": self.id, "object_prefix": self.meta["object_prefix"],
+                "num_objs": self._object_count(self.meta["size"]),
+                "parent": self.meta.get("parent"),
+                "snapshots": self.meta["snapshots"]}
+
+    # -- parent (clone) plumbing -------------------------------------------
+    async def _get_parent(self) -> "Image | None":
+        pref = self.meta.get("parent")
+        if pref is None:
+            return None
+        if self._parent is None:
+            pools = self.ioctx.objecter.osdmap.pool_names
+            pname = next((n for n, i in pools.items()
+                          if i == pref["pool_id"]), None)
+            if pname is None:
+                raise RbdError("ENOENT", "parent pool vanished")
+            pioctx = await self.ioctx.rados.open_ioctx(pname)
+            meta = json.loads(await pioctx.exec(
+                _header(pref["image_id"]), "rbd", "get_image_meta", b""))
+            self._parent = Image(pioctx, "", pref["image_id"], meta,
+                                 True, pref["snap_id"])
+        return self._parent
+
+    async def _read_parent(self, off: int, length: int) -> bytes:
+        """Read [off, off+length) from the parent snapshot, clipped to
+        the overlap; beyond-overlap reads are zeros."""
+        parent = await self._get_parent()
+        # a shrink below the overlap implicitly truncates it (the
+        # reference updates the overlap on resize; clipping reads the
+        # same way keeps one source of truth -- the current size)
+        overlap = min(self.meta["parent"]["overlap"], self.meta["size"])
+        if parent is None or off >= overlap:
+            return b"\0" * length
+        n = min(length, overlap - off)
+        buf = await parent.read(off, n)
+        return buf + b"\0" * (length - len(buf))
+
+    # -- data path ----------------------------------------------------------
+    async def read(self, off: int, length: int) -> bytes:
+        size = await self.size()
+        if off >= size:
+            return b""
+        length = min(length, size - off)
+        lay = self._layout
+        extents = map_extents(lay, off, length)
+
+        async def read_one(idx, objectno, obj_off, n):
+            try:
+                buf = await self.ioctx.read(
+                    self._data_obj(objectno), length=n, offset=obj_off,
+                    snap=self.snap_id)
+                return idx, buf + b"\0" * (n - len(buf)), False
+            except RadosError as e:
+                if e.errno_name != "ENOENT":
+                    raise
+                return idx, None, True      # hole: maybe parent data
+
+        jobs = []
+        logical = []                        # per-extent image offset
+        pos = off
+        for i, (objectno, obj_off, n) in enumerate(extents):
+            jobs.append(read_one(i, objectno, obj_off, n))
+            logical.append(pos)
+            pos += n
+        done = await asyncio.gather(*jobs)
+        pieces: list[bytes] = [b""] * len(extents)
+        for idx, buf, hole in done:
+            if hole:
+                n = extents[idx][2]
+                if self.meta.get("parent"):
+                    buf = await self._read_parent(logical[idx], n)
+                else:
+                    buf = b"\0" * n
+            pieces[idx] = buf
+        return b"".join(pieces)
+
+    async def _copyup(self, objectno: int) -> None:
+        """First write to a clone's missing object: materialize the
+        parent's bytes for the whole object first (CopyupRequest)."""
+        lay = self._layout
+        obj_logical = objectno * lay.object_size   # sc==1 path
+        overlap = min(self.meta["parent"]["overlap"], self.meta["size"])
+        if obj_logical >= overlap:
+            return
+        n = min(lay.object_size, overlap - obj_logical)
+        buf = await self._read_parent(obj_logical, n)
+        if buf.strip(b"\0"):
+            try:
+                await self.ioctx.write(self._data_obj(objectno), buf,
+                                       offset=0)
+            except RadosError as e:
+                raise _wrap(e) from e
+
+    async def write(self, off: int, data: bytes) -> int:
+        if self.read_only:
+            raise RbdError("EROFS")
+        size = self.meta["size"]
+        if off + len(data) > size:
+            raise RbdError("EINVAL", "write past end of image")
+        lay = self._layout
+        has_parent = bool(self.meta.get("parent"))
+
+        async def write_one(objectno, obj_off, piece):
+            if has_parent and lay.stripe_count == 1:
+                try:
+                    await self.ioctx.stat(self._data_obj(objectno))
+                except RadosError as e:
+                    if e.errno_name == "ENOENT":
+                        await self._copyup(objectno)
+                    else:
+                        raise
+            await self.ioctx.write(self._data_obj(objectno), piece,
+                                   offset=obj_off)
+
+        jobs = []
+        pos = 0
+        for objectno, obj_off, n in map_extents(lay, off, len(data)):
+            jobs.append(write_one(objectno, obj_off,
+                                  data[pos:pos + n]))
+            pos += n
+        try:
+            await asyncio.gather(*jobs)
+        except RadosError as e:
+            raise _wrap(e) from e
+        return len(data)
+
+    async def discard(self, off: int, length: int) -> None:
+        """Deallocate a range: whole objects are removed, partial
+        ranges zeroed (ImageRequest discard)."""
+        if self.read_only:
+            raise RbdError("EROFS")
+        lay = self._layout
+        has_parent = bool(self.meta.get("parent"))
+
+        async def one(objectno, obj_off, n):
+            oid = self._data_obj(objectno)
+            try:
+                if obj_off == 0 and n == lay.object_size \
+                        and not has_parent:
+                    await self.ioctx.remove(oid)
+                    return
+                if has_parent and lay.stripe_count == 1:
+                    # an absent clone object must copyup first: a bare
+                    # zero() is a no-op on a missing object and reads
+                    # would fall through to PARENT bytes, not zeros
+                    try:
+                        await self.ioctx.stat(oid)
+                    except RadosError as e:
+                        if e.errno_name != "ENOENT":
+                            raise
+                        await self._copyup(objectno)
+                await self.ioctx.zero(oid, obj_off, n)
+            except RadosError as e:
+                if e.errno_name != "ENOENT":
+                    raise
+        try:
+            await _gather_bounded(
+                [one(*e) for e in map_extents(lay, off, length)])
+        except RadosError as e:
+            raise _wrap(e) from e
+
+    async def _remove_data_obj(self, objectno: int) -> None:
+        try:
+            await self.ioctx.remove(self._data_obj(objectno))
+        except RadosError as e:
+            if e.errno_name != "ENOENT":
+                raise
+
+    # -- resize -------------------------------------------------------------
+    async def resize(self, new_size: int) -> None:
+        if self.read_only:
+            raise RbdError("EROFS")
+        old = self.meta["size"]
+        if new_size < old:
+            lay = self._layout
+            keep = self._object_count(new_size)
+            total = self._object_count(old)
+            # trim the boundary object, drop the rest
+            if new_size % lay.object_size and keep:
+                boundary = self._data_obj(keep - 1)
+                try:
+                    await self.ioctx.truncate(
+                        boundary, new_size % lay.object_size)
+                except RadosError as e:
+                    if e.errno_name != "ENOENT":
+                        raise _wrap(e) from e
+            await _gather_bounded(
+                [self._remove_data_obj(i) for i in range(keep, total)])
+        await self.ioctx.exec(_header(self.id), "rbd", "set_size",
+                              json.dumps({"size": new_size}).encode())
+        await self._refresh_meta()
+
+    # -- snapshots -----------------------------------------------------------
+    async def create_snap(self, snap_name: str) -> int:
+        if self.read_only:
+            raise RbdError("EROFS")
+        sid = await self.ioctx.selfmanaged_snap_create()
+        try:
+            await self.ioctx.exec(
+                _header(self.id), "rbd", "snapshot_add",
+                json.dumps({"snap_id": sid,
+                            "name": snap_name}).encode())
+        except RadosError as e:
+            await self.ioctx.selfmanaged_snap_remove(sid)
+            raise _wrap(e) from e
+        await self._refresh_meta()
+        await self._refresh_snapc()
+        return sid
+
+    async def remove_snap(self, snap_name: str) -> None:
+        if self.read_only:
+            raise RbdError("EROFS")
+        snap = self._snap_by_name(snap_name)
+        kids = json.loads(await self.ioctx.exec(
+            RBD_CHILDREN, "rbd", "list_children", json.dumps({
+                "pool_id": self.ioctx.pool_id, "image_id": self.id,
+                "snap_id": snap["id"]}).encode()))
+        if kids:
+            raise RbdError("EBUSY", f"snap has {len(kids)} children")
+        try:
+            await self.ioctx.exec(
+                _header(self.id), "rbd", "snapshot_remove",
+                json.dumps({"snap_id": snap["id"]}).encode())
+        except RadosError as e:
+            raise _wrap(e) from e
+        await self.ioctx.selfmanaged_snap_remove(snap["id"])
+        await self._refresh_meta()
+        await self._refresh_snapc()
+
+    async def protect_snap(self, snap_name: str) -> None:
+        snap = self._snap_by_name(snap_name)
+        await self.ioctx.exec(_header(self.id), "rbd",
+                              "snapshot_protect",
+                              json.dumps({"snap_id": snap["id"]}).encode())
+        await self._refresh_meta()
+
+    async def unprotect_snap(self, snap_name: str) -> None:
+        snap = self._snap_by_name(snap_name)
+        kids = json.loads(await self.ioctx.exec(
+            RBD_CHILDREN, "rbd", "list_children", json.dumps({
+                "pool_id": self.ioctx.pool_id, "image_id": self.id,
+                "snap_id": snap["id"]}).encode()))
+        if kids:
+            raise RbdError("EBUSY", f"snap has {len(kids)} children")
+        await self.ioctx.exec(_header(self.id), "rbd",
+                              "snapshot_unprotect",
+                              json.dumps({"snap_id": snap["id"]}).encode())
+        await self._refresh_meta()
+
+    def list_snaps(self) -> list[dict]:
+        return list(self.meta["snapshots"])
+
+    async def rollback_snap(self, snap_name: str) -> None:
+        """Rewrite head data from the snapshot (Operations::snap_rollback).
+        Object-by-object copy of the snap content over the head."""
+        if self.read_only:
+            raise RbdError("EROFS")
+        snap = self._snap_by_name(snap_name)
+        lay = self._layout
+        await self.resize(snap["size"])
+        n_objs = self._object_count(snap["size"])
+
+        async def roll(objectno):
+            oid = self._data_obj(objectno)
+            try:
+                buf = await self.ioctx.read(oid, snap=snap["id"])
+                await self.ioctx.write_full(oid, buf)
+            except RadosError as e:
+                if e.errno_name != "ENOENT":
+                    raise
+                await self._remove_data_obj(objectno)
+        try:
+            await _gather_bounded([roll(i) for i in range(n_objs)])
+        except RadosError as e:
+            raise _wrap(e) from e
+
+    # -- flatten -------------------------------------------------------------
+    async def flatten(self) -> None:
+        """Copy all parent data up, then sever the parent link
+        (librbd::Operations::flatten)."""
+        if self.read_only:
+            raise RbdError("EROFS")
+        pref = self.meta.get("parent")
+        if pref is None:
+            raise RbdError("EINVAL", "image has no parent")
+        n_objs = self._object_count(
+            min(pref["overlap"], self.meta["size"]))
+
+        async def up(objectno):
+            try:
+                await self.ioctx.stat(self._data_obj(objectno))
+            except RadosError as e:
+                if e.errno_name == "ENOENT":
+                    await self._copyup(objectno)
+                else:
+                    raise
+        try:
+            await _gather_bounded([up(i) for i in range(n_objs)])
+            await self.ioctx.exec(_header(self.id), "rbd",
+                                  "remove_parent", b"")
+            parent = await self._get_parent()
+            await parent.ioctx.exec(
+                RBD_CHILDREN, "rbd", "remove_child", json.dumps({
+                    **pref, "child_id": self.id}).encode())
+        except RadosError as e:
+            raise _wrap(e) from e
+        if self._parent is not None:
+            await self._parent.close()
+            self._parent = None
+        await self._refresh_meta()
+
+    # -- import/export helpers (rbd CLI) ------------------------------------
+    async def export(self, chunk: int = 1 << 22):
+        """Async iterator of (offset, bytes) over the whole image."""
+        size = await self.size()
+        off = 0
+        while off < size:
+            n = min(chunk, size - off)
+            yield off, await self.read(off, n)
+            off += n

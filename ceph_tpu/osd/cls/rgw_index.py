@@ -1,0 +1,146 @@
+"""cls_rgw (bucket index subset): atomic bucket-index maintenance.
+
+Mirrors the src/cls/rgw/cls_rgw.cc bucket-index ops the gateway's
+write path uses: ``prepare`` marks an in-flight op on the key,
+``complete`` commits the entry (or removes it for a delete) and drops
+the pending marker, ``unlink`` removes an entry, ``list`` pages
+entries.  Index entries live in the bucket index object's omap keyed
+by object name, so concurrent gateway instances get atomic
+read-modify-write through the OSD rather than racing client-side
+(the reason the reference keeps the index in a class).
+"""
+
+from __future__ import annotations
+
+import json
+
+from . import CLS_METHOD_RD, CLS_METHOD_WR, ClsError, register
+
+_ENTRY = "idx_"          # idx_<object key> -> entry json
+_PENDING = "pend_"       # pend_<tag> -> {key, op}
+
+
+@register("rgw_index", "prepare", CLS_METHOD_RD | CLS_METHOD_WR)
+def prepare(hctx, indata: bytes) -> bytes:
+    q = json.loads(indata)
+    if not hctx.exists():
+        hctx.create(exclusive=False)
+    hctx.map_set_val(_PENDING + q["tag"], json.dumps(
+        {"key": q["key"], "op": q.get("op", "put")}).encode())
+    return b""
+
+
+@register("rgw_index", "complete", CLS_METHOD_RD | CLS_METHOD_WR)
+def complete(hctx, indata: bytes) -> bytes:
+    q = json.loads(indata)
+    tag = q.get("tag")
+    if tag is not None:
+        try:
+            hctx.map_get_val(_PENDING + tag)
+            hctx.map_remove_key(_PENDING + tag)
+        except ClsError:
+            raise ClsError("ECANCELED", "no pending op for tag")
+    if q.get("op") == "del":
+        try:
+            hctx.map_get_val(_ENTRY + q["key"])
+        except ClsError:
+            raise ClsError("ENOENT", q["key"])
+        hctx.map_remove_key(_ENTRY + q["key"])
+    else:
+        hctx.map_set_val(_ENTRY + q["key"],
+                         json.dumps(q["entry"]).encode())
+    return b""
+
+
+@register("rgw_index", "unlink", CLS_METHOD_RD | CLS_METHOD_WR)
+def unlink(hctx, indata: bytes) -> bytes:
+    q = json.loads(indata)
+    try:
+        hctx.map_get_val(_ENTRY + q["key"])
+    except ClsError:
+        raise ClsError("ENOENT", q["key"])
+    hctx.map_remove_key(_ENTRY + q["key"])
+    return b""
+
+
+@register("rgw_index", "get", CLS_METHOD_RD)
+def get(hctx, indata: bytes) -> bytes:
+    q = json.loads(indata)
+    try:
+        return hctx.map_get_val(_ENTRY + q["key"])
+    except ClsError:
+        raise ClsError("ENOENT", q["key"])
+
+
+@register("rgw_index", "list", CLS_METHOD_RD)
+def list_entries(hctx, indata: bytes) -> bytes:
+    """Paged listing: {prefix, marker, max} ->
+    {entries: [[key, entry], ...], truncated}."""
+    q = json.loads(indata or b"{}")
+    prefix = q.get("prefix", "")
+    marker = q.get("marker", "")
+    limit = int(q.get("max", 1000))
+    if not hctx.exists():
+        return json.dumps({"entries": [], "truncated": False}).encode()
+    all_kv = hctx.map_get_all()
+    keys = sorted(k[len(_ENTRY):] for k in all_kv
+                  if k.startswith(_ENTRY))
+    keys = [k for k in keys if k.startswith(prefix) and k > marker]
+    page = keys[:limit]
+    entries = [[k, json.loads(all_kv[_ENTRY + k])] for k in page]
+    return json.dumps({"entries": entries,
+                       "truncated": len(keys) > limit}).encode()
+
+
+@register("rgw_index", "dir_link", CLS_METHOD_RD | CLS_METHOD_WR)
+def dir_link(hctx, indata: bytes) -> bytes:
+    """Atomic registry insert (bucket directory): fails EEXIST unless
+    the existing value's owner matches (idempotent re-create).  The
+    check and the write commit in one op -- client-side
+    read-modify-write would let two gateways each claim the name."""
+    q = json.loads(indata)
+    if not hctx.exists():
+        hctx.create(exclusive=False)
+    try:
+        cur = json.loads(hctx.map_get_val("dir_" + q["name"]))
+        if cur.get("owner") != q["meta"].get("owner"):
+            raise ClsError("EEXIST", q["name"])
+        return json.dumps(cur).encode()
+    except ClsError as e:
+        if e.errno_name == "EEXIST":
+            raise
+    hctx.map_set_val("dir_" + q["name"],
+                     json.dumps(q["meta"]).encode())
+    return json.dumps(q["meta"]).encode()
+
+
+@register("rgw_index", "dir_unlink", CLS_METHOD_RD | CLS_METHOD_WR)
+def dir_unlink(hctx, indata: bytes) -> bytes:
+    q = json.loads(indata)
+    try:
+        hctx.map_get_val("dir_" + q["name"])
+    except ClsError:
+        raise ClsError("ENOENT", q["name"])
+    hctx.map_remove_key("dir_" + q["name"])
+    return b""
+
+
+@register("rgw_index", "dir_list", CLS_METHOD_RD)
+def rgw_dir_list(hctx, indata: bytes) -> bytes:
+    if not hctx.exists():
+        return json.dumps({}).encode()
+    out = {k[4:]: json.loads(v) for k, v in hctx.map_get_all().items()
+           if k.startswith("dir_")}
+    return json.dumps(out).encode()
+
+
+@register("rgw_index", "stats", CLS_METHOD_RD)
+def stats(hctx, indata: bytes) -> bytes:
+    if not hctx.exists():
+        return json.dumps({"count": 0, "bytes": 0}).encode()
+    count = tot = 0
+    for k, v in hctx.map_get_all().items():
+        if k.startswith(_ENTRY):
+            count += 1
+            tot += json.loads(v).get("size", 0)
+    return json.dumps({"count": count, "bytes": tot}).encode()

@@ -1,0 +1,388 @@
+"""RGW frontend: asyncio HTTP server speaking the S3 REST dialect.
+
+The request pump mirrors src/rgw/rgw_process.cc:265 process_request:
+parse -> authenticate (AWS SigV4, src/rgw/rgw_auth_s3.cc) -> resolve
+op -> execute against the SAL store -> emit XML.  One handler task per
+connection (the asio frontend's strand-per-connection analog).
+
+Supported: bucket create/delete/list, ListObjectsV2 (prefix/delimiter/
+continuation), object PUT/GET(ranged)/HEAD/DELETE, x-amz-copy-source
+copies, multipart initiate/upload-part/complete/abort, SigV4 auth with
+UNSIGNED-PAYLOAD or signed-payload hashes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import re
+import time
+import urllib.parse
+from xml.etree import ElementTree as ET
+from xml.sax.saxutils import escape
+
+from .store import RgwError, RgwStore
+
+MAX_BODY = 1 << 30
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_signature(secret: str, date_stamp: str, region: str,
+                    service: str, string_to_sign: str) -> str:
+    k = _sign(("AWS4" + secret).encode(), date_stamp)
+    k = _sign(k, region)
+    k = _sign(k, service)
+    k = _sign(k, "aws4_request")
+    return hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
+
+
+class HttpRequest:
+    def __init__(self, method, path, query, headers, body):
+        self.method = method
+        self.raw_path = path
+        self.path = urllib.parse.unquote(path)
+        self.query = query                  # dict[str, str]
+        self.headers = headers              # lowercased keys
+        self.body = body
+
+
+class Gateway:
+    def __init__(self, store: RgwStore, region: str = "default") -> None:
+        self.store = store
+        self.region = region
+        self._server: asyncio.AbstractServer | None = None
+        self.addr: tuple[str, int] | None = None
+
+    async def start(self, host: str = "127.0.0.1",
+                    port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._serve_conn,
+                                                  host, port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- connection handling -------------------------------------------------
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                status, headers, body = await self._handle(req)
+                await self._respond(writer, req, status, headers, body)
+                if req.headers.get("connection", "").lower() == "close":
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _read_request(self, reader) -> HttpRequest | None:
+        try:
+            line = await asyncio.wait_for(reader.readline(), 300)
+        except asyncio.TimeoutError:
+            return None
+        if not line:
+            return None
+        try:
+            method, target, _version = line.decode().split(" ", 2)
+        except ValueError:
+            return None
+        headers: dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        parsed = urllib.parse.urlsplit(target)
+        query = dict(urllib.parse.parse_qsl(parsed.query,
+                                            keep_blank_values=True))
+        n = int(headers.get("content-length", "0") or "0")
+        if n > MAX_BODY:
+            return None
+        body = await reader.readexactly(n) if n else b""
+        return HttpRequest(method.upper(), parsed.path, query, headers,
+                           body)
+
+    async def _respond(self, writer, req, status, headers, body):
+        reason = {200: "OK", 204: "No Content", 206: "Partial Content",
+                  400: "Bad Request", 403: "Forbidden",
+                  404: "Not Found", 409: "Conflict",
+                  416: "Range Not Satisfiable",
+                  500: "Internal Server Error"}.get(status, "OK")
+        headers.setdefault("content-length", str(len(body)))
+        headers.setdefault("x-amz-request-id", f"{time.time_ns():x}")
+        lines = [f"HTTP/1.1 {status} {reason}"]
+        lines += [f"{k}: {v}" for k, v in headers.items()]
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode())
+        if req.method != "HEAD":
+            writer.write(body)
+        await writer.drain()
+
+    # -- auth (AWS SigV4, src/rgw/rgw_auth_s3.cc) ---------------------------
+    async def _authenticate(self, req: HttpRequest) -> dict:
+        auth = req.headers.get("authorization", "")
+        m = re.match(
+            r"AWS4-HMAC-SHA256 Credential=([^/]+)/(\d+)/([^/]+)/([^/]+)"
+            r"/aws4_request,\s*SignedHeaders=([^,]+),\s*Signature=(\w+)",
+            auth)
+        if not m:
+            raise RgwError("AccessDenied", 403, "missing/bad auth header")
+        access_key, date_stamp, region, service, signed_hdrs, sig = \
+            m.groups()
+        user = await self.store.get_user(access_key)
+        if user is None:
+            raise RgwError("InvalidAccessKeyId", 403, access_key)
+        payload_hash = req.headers.get(
+            "x-amz-content-sha256", "UNSIGNED-PAYLOAD")
+        if payload_hash not in ("UNSIGNED-PAYLOAD",
+                                "STREAMING-UNSIGNED-PAYLOAD-TRAILER"):
+            if hashlib.sha256(req.body).hexdigest() != payload_hash:
+                raise RgwError("XAmzContentSHA256Mismatch", 400)
+        canonical_query = "&".join(
+            f"{urllib.parse.quote(k, safe='-_.~')}="
+            f"{urllib.parse.quote(v, safe='-_.~')}"
+            for k, v in sorted(req.query.items()))
+        names = signed_hdrs.split(";")
+        canonical_headers = "".join(
+            f"{h}:{' '.join(req.headers.get(h, '').split())}\n"
+            for h in names)
+        canonical = "\n".join([
+            req.method, urllib.parse.quote(req.path, safe="/-_.~"),
+            canonical_query, canonical_headers, signed_hdrs,
+            payload_hash])
+        amz_date = req.headers.get("x-amz-date", "")
+        scope = f"{date_stamp}/{region}/{service}/aws4_request"
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest()])
+        want = sigv4_signature(user["secret"], date_stamp, region,
+                               service, string_to_sign)
+        if not hmac.compare_digest(want, sig):
+            raise RgwError("SignatureDoesNotMatch", 403)
+        return user
+
+    # -- dispatch ------------------------------------------------------------
+    async def _handle(self, req: HttpRequest):
+        try:
+            user = await self._authenticate(req)
+            parts = req.path.lstrip("/").split("/", 1)
+            bucket = parts[0]
+            key = parts[1] if len(parts) > 1 else ""
+            if not bucket:
+                return await self._list_buckets(user)
+            if not key:
+                return await self._bucket_op(req, user, bucket)
+            return await self._object_op(req, user, bucket, key)
+        except RgwError as e:
+            return self._error_response(e)
+        except (ValueError, KeyError, ET.ParseError) as e:
+            # malformed request params/XML must yield an HTTP error,
+            # not a torn-down connection with no status line
+            return self._error_response(
+                RgwError("InvalidRequest", 400,
+                         f"{type(e).__name__}: {e}"))
+        except Exception as e:              # noqa: BLE001 -- last resort
+            return self._error_response(
+                RgwError("InternalError", 500, type(e).__name__))
+
+    @staticmethod
+    def _error_response(e: RgwError):
+        body = (f'<?xml version="1.0" encoding="UTF-8"?>'
+                f"<Error><Code>{e.code}</Code>"
+                f"<Message>{escape(str(e))}</Message></Error>"
+                ).encode()
+        return e.status, {"content-type": "application/xml"}, body
+
+    async def _list_buckets(self, user):
+        buckets = await self.store.list_buckets(owner=user["uid"])
+        items = "".join(
+            f"<Bucket><Name>{escape(b['name'])}</Name>"
+            f"<CreationDate>{b['created']}</CreationDate></Bucket>"
+            for b in buckets)
+        body = (f'<?xml version="1.0" encoding="UTF-8"?>'
+                f'<ListAllMyBucketsResult xmlns="{XMLNS}">'
+                f"<Owner><ID>{escape(user['uid'])}</ID></Owner>"
+                f"<Buckets>{items}</Buckets>"
+                f"</ListAllMyBucketsResult>").encode()
+        return 200, {"content-type": "application/xml"}, body
+
+    async def _bucket_op(self, req, user, bucket):
+        if req.method == "PUT":
+            await self.store.create_bucket(bucket, user["uid"])
+            return 200, {"location": f"/{bucket}"}, b""
+        if req.method == "DELETE":
+            await self.store.delete_bucket(bucket)
+            return 204, {}, b""
+        if req.method in ("GET", "HEAD"):
+            if "uploads" in req.query:
+                return 200, {"content-type": "application/xml"}, (
+                    f'<?xml version="1.0"?><ListMultipartUploadsResult '
+                    f'xmlns="{XMLNS}"></ListMultipartUploadsResult>'
+                ).encode()
+            return await self._list_objects_v2(req, bucket)
+        raise RgwError("MethodNotAllowed", 400, req.method)
+
+    async def _list_objects_v2(self, req, bucket):
+        prefix = req.query.get("prefix", "")
+        delim = req.query.get("delimiter", "")
+        max_keys = int(req.query.get("max-keys", "1000"))
+        marker = req.query.get("continuation-token",
+                               req.query.get("start-after",
+                                             req.query.get("marker", "")))
+        out = await self.store.list_objects(
+            bucket, prefix=prefix, marker=marker, max_keys=max_keys,
+            delimiter=delim)
+        contents = "".join(
+            f"<Contents><Key>{escape(k)}</Key>"
+            f"<LastModified>{e['mtime']}</LastModified>"
+            f"<ETag>&quot;{e['etag']}&quot;</ETag>"
+            f"<Size>{e['size']}</Size>"
+            f"<StorageClass>STANDARD</StorageClass></Contents>"
+            for k, e in out["entries"])
+        commons = "".join(
+            f"<CommonPrefixes><Prefix>{escape(p)}</Prefix>"
+            f"</CommonPrefixes>" for p in out["prefixes"])
+        trunc = "true" if out["truncated"] else "false"
+        nct = (f"<NextContinuationToken>{escape(out['next_marker'])}"
+               f"</NextContinuationToken>" if out["truncated"] else "")
+        body = (f'<?xml version="1.0" encoding="UTF-8"?>'
+                f'<ListBucketResult xmlns="{XMLNS}">'
+                f"<Name>{escape(bucket)}</Name>"
+                f"<Prefix>{escape(prefix)}</Prefix>"
+                f"<KeyCount>{len(out['entries'])}</KeyCount>"
+                f"<MaxKeys>{max_keys}</MaxKeys>"
+                f"<IsTruncated>{trunc}</IsTruncated>{nct}"
+                f"{contents}{commons}</ListBucketResult>").encode()
+        return 200, {"content-type": "application/xml"}, body
+
+    async def _object_op(self, req, user, bucket, key):
+        q = req.query
+        if req.method == "POST" and "uploads" in q:
+            uid = await self.store.initiate_multipart(
+                bucket, key, user["uid"],
+                req.headers.get("content-type", ""))
+            body = (f'<?xml version="1.0"?>'
+                    f'<InitiateMultipartUploadResult xmlns="{XMLNS}">'
+                    f"<Bucket>{escape(bucket)}</Bucket>"
+                    f"<Key>{escape(key)}</Key>"
+                    f"<UploadId>{uid}</UploadId>"
+                    f"</InitiateMultipartUploadResult>").encode()
+            return 200, {"content-type": "application/xml"}, body
+        if req.method == "PUT" and "uploadId" in q:
+            part = await self.store.put_part(
+                bucket, key, q["uploadId"], int(q["partNumber"]),
+                req.body)
+            return 200, {"etag": f'"{part["etag"]}"'}, b""
+        if req.method == "POST" and "uploadId" in q:
+            root = ET.fromstring(req.body)
+            ns = root.tag.partition("}")[0] + "}" \
+                if root.tag.startswith("{") else ""
+            nums = sorted(int(p.findtext(f"{ns}PartNumber"))
+                          for p in root.findall(f"{ns}Part"))
+            entry = await self.store.complete_multipart(
+                bucket, key, q["uploadId"], nums)
+            body = (f'<?xml version="1.0"?>'
+                    f'<CompleteMultipartUploadResult xmlns="{XMLNS}">'
+                    f"<Bucket>{escape(bucket)}</Bucket>"
+                    f"<Key>{escape(key)}</Key>"
+                    f"<ETag>&quot;{entry['etag']}&quot;</ETag>"
+                    f"</CompleteMultipartUploadResult>").encode()
+            return 200, {"content-type": "application/xml"}, body
+        if req.method == "DELETE" and "uploadId" in q:
+            await self.store.abort_multipart(bucket, key, q["uploadId"])
+            return 204, {}, b""
+        if req.method == "PUT":
+            src = req.headers.get("x-amz-copy-source")
+            if src:
+                sb, _, sk = urllib.parse.unquote(
+                    src.lstrip("/")).partition("/")
+                src_entry, data = await self.store.get_object(sb, sk)
+                # S3 CopyObject defaults to the COPY metadata
+                # directive: source content-type + x-amz-meta carry over
+                replace = req.headers.get(
+                    "x-amz-metadata-directive", "COPY") == "REPLACE"
+                entry = await self.store.put_object(
+                    bucket, key, data, owner=user["uid"],
+                    content_type=(req.headers.get("content-type", "")
+                                  if replace
+                                  else src_entry.get("content_type", "")),
+                    meta=({k[len("x-amz-meta-"):]: v
+                           for k, v in req.headers.items()
+                           if k.startswith("x-amz-meta-")}
+                          if replace else src_entry.get("meta", {})))
+                body = (f'<?xml version="1.0"?><CopyObjectResult>'
+                        f"<ETag>&quot;{entry['etag']}&quot;</ETag>"
+                        f"<LastModified>{entry['mtime']}</LastModified>"
+                        f"</CopyObjectResult>").encode()
+                return 200, {"content-type": "application/xml"}, body
+            meta = {k[len("x-amz-meta-"):]: v
+                    for k, v in req.headers.items()
+                    if k.startswith("x-amz-meta-")}
+            entry = await self.store.put_object(
+                bucket, key, req.body, owner=user["uid"],
+                content_type=req.headers.get("content-type", ""),
+                meta=meta)
+            return 200, {"etag": f'"{entry["etag"]}"'}, b""
+        if req.method in ("GET", "HEAD"):
+            off, length = 0, None
+            status = 200
+            rng = req.headers.get("range")
+            entry = await self.store.get_entry(bucket, key)
+            if rng:
+                m = re.match(r"bytes=(\d*)-(\d*)$", rng)
+                if not m or (not m.group(1) and not m.group(2)):
+                    raise RgwError("InvalidRange", 416, rng)
+                if m.group(1):
+                    off = int(m.group(1))
+                    end = (int(m.group(2)) if m.group(2)
+                           else entry["size"] - 1)
+                else:                       # suffix range: last N bytes
+                    off = max(0, entry["size"] - int(m.group(2)))
+                    end = entry["size"] - 1
+                if off >= entry["size"]:
+                    raise RgwError("InvalidRange", 416, rng)
+                end = min(end, entry["size"] - 1)
+                length = end - off + 1
+                status = 206
+            if req.method == "HEAD":
+                data = b""
+            else:
+                entry, data = await self.store.get_object(
+                    bucket, key, off, length)
+            headers = {
+                "content-type": entry.get("content_type")
+                or "binary/octet-stream",
+                "etag": f'"{entry["etag"]}"',
+                "last-modified": entry["mtime"],
+                "content-length": str(len(data) if req.method == "GET"
+                                      else (length if length is not None
+                                            else entry["size"])),
+                "accept-ranges": "bytes",
+            }
+            for mk, mv in entry.get("meta", {}).items():
+                headers[f"x-amz-meta-{mk}"] = mv
+            if status == 206:
+                headers["content-range"] = (
+                    f"bytes {off}-{off + length - 1}/{entry['size']}")
+            return status, headers, data
+        if req.method == "DELETE":
+            await self.store.delete_object(bucket, key)
+            return 204, {}, b""
+        raise RgwError("MethodNotAllowed", 400, req.method)

@@ -1,0 +1,384 @@
+"""RGW SAL layer: users, buckets, objects over RADOS.
+
+The rados-driver schema (a compressed rendering of
+src/rgw/driver/rados/rgw_rados.cc):
+
+    rgw_users                 omap: access_key -> {secret, uid, display}
+    rgw_buckets               omap: bucket -> {id, owner, created}
+    bucket_index.<id>         per-bucket index (cls rgw_index omap)
+    <id>__shadow_<key>        object data (striped when large)
+    <id>__multipart_<key>.<uploadid>.<n>   multipart part data
+
+Object data rides the client-side striper (one logical object -> many
+RADOS objects) the way RGW manifests split heads from tails
+(rgw_obj_manifest); the head's index entry carries size/etag/manifest.
+Writes go through the cls_rgw-style prepare/complete dance so a
+crashed gateway leaves a pending marker, not a half-linked entry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+from ..client.rados import RadosError
+from ..client.striper import Layout, RadosStriper
+
+USERS_OID = "rgw_users"
+BUCKETS_OID = "rgw_buckets"
+
+
+class RgwError(Exception):
+    """Carries the S3 error code (NoSuchBucket, NoSuchKey...)."""
+
+    def __init__(self, code: str, status: int, detail: str = "") -> None:
+        super().__init__(f"{code}: {detail}" if detail else code)
+        self.code = code
+        self.status = status
+
+
+def _now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime())
+
+
+class RgwStore:
+    def __init__(self, ioctx, stripe_unit: int = 1 << 22) -> None:
+        self.ioctx = ioctx
+        self.striper = RadosStriper(
+            ioctx, Layout(stripe_unit=stripe_unit,
+                          object_size=stripe_unit))
+
+    # -- users (RGWUserCtl / radosgw-admin user create) ---------------------
+    async def create_user(self, uid: str, display_name: str,
+                          access_key: str | None = None,
+                          secret: str | None = None) -> dict:
+        access_key = access_key or os.urandom(10).hex().upper()
+        secret = secret or os.urandom(20).hex()
+        user = {"uid": uid, "display_name": display_name,
+                "access_key": access_key, "secret": secret}
+        await self.ioctx.set_omap(USERS_OID,
+                                  {access_key: json.dumps(user).encode()})
+        return user
+
+    async def get_user(self, access_key: str) -> dict | None:
+        try:
+            omap = await self.ioctx.get_omap(USERS_OID)
+        except RadosError:
+            return None
+        raw = omap.get(access_key)
+        return json.loads(raw) if raw else None
+
+    # -- buckets ------------------------------------------------------------
+    async def _buckets(self) -> dict[str, dict]:
+        try:
+            raw = await self.ioctx.exec(BUCKETS_OID, "rgw_index",
+                                        "dir_list", b"")
+        except RadosError:
+            return {}
+        return json.loads(raw)
+
+    async def create_bucket(self, name: str, owner: str) -> dict:
+        # the exists/owner check and the insert commit atomically in
+        # the OSD (cls dir_link) -- two concurrent gateways racing the
+        # same name must not both win with different bucket ids
+        b = {"id": os.urandom(8).hex(), "owner": owner,
+             "created": _now_iso(), "name": name}
+        try:
+            raw = await self.ioctx.exec(
+                BUCKETS_OID, "rgw_index", "dir_link",
+                json.dumps({"name": name, "meta": b}).encode())
+        except RadosError as e:
+            if e.errno_name == "EEXIST":
+                raise RgwError("BucketAlreadyExists", 409, name) from e
+            raise
+        return json.loads(raw)     # existing meta on idempotent re-create
+
+    async def get_bucket(self, name: str) -> dict:
+        b = (await self._buckets()).get(name)
+        if b is None:
+            raise RgwError("NoSuchBucket", 404, name)
+        return b
+
+    async def delete_bucket(self, name: str) -> None:
+        b = await self.get_bucket(name)
+        listing = await self.list_objects(name, max_keys=1)
+        if listing["entries"]:
+            raise RgwError("BucketNotEmpty", 409, name)
+        try:
+            await self.ioctx.exec(BUCKETS_OID, "rgw_index", "dir_unlink",
+                                  json.dumps({"name": name}).encode())
+        except RadosError as e:
+            if e.errno_name != "ENOENT":
+                raise
+        try:
+            await self.ioctx.remove(self._index(b))
+        except RadosError:
+            pass
+
+    async def list_buckets(self, owner: str | None = None) -> list[dict]:
+        out = [b for b in (await self._buckets()).values()
+               if owner is None or b["owner"] == owner]
+        return sorted(out, key=lambda b: b["name"])
+
+    # -- objects ------------------------------------------------------------
+    def _index(self, bucket: dict) -> str:
+        return f"bucket_index.{bucket['id']}"
+
+    def _data_oid(self, bucket: dict, key: str) -> str:
+        return f"{bucket['id']}__shadow_{key}"
+
+    def _part_oid(self, bucket: dict, key: str, upload_id: str,
+                  part: int) -> str:
+        return f"{bucket['id']}__multipart_{key}.{upload_id}.{part}"
+
+    async def _purge_data(self, bucket: dict, key: str,
+                          entry: dict | None) -> None:
+        """Remove an entry's backing data -- manifest parts for a
+        completed multipart object, the shadow object otherwise.  An
+        overwrite that skips this leaks the old parts forever (the
+        index entry was their only reference)."""
+        if entry and "manifest" in entry:
+            for part in entry["manifest"]:
+                await self.striper.remove(part["oid"])
+        await self.striper.remove(self._data_oid(bucket, key))
+
+    async def _old_entry(self, bucket_name: str, key: str) -> dict | None:
+        try:
+            return await self.get_entry(bucket_name, key)
+        except RgwError:
+            return None
+
+    async def put_object(self, bucket_name: str, key: str, data: bytes,
+                         owner: str = "", content_type: str = "",
+                         meta: dict | None = None) -> dict:
+        bucket = await self.get_bucket(bucket_name)
+        tag = os.urandom(8).hex()
+        idx = self._index(bucket)
+        await self.ioctx.exec(idx, "rgw_index", "prepare", json.dumps(
+            {"tag": tag, "key": key, "op": "put"}).encode())
+        soid = self._data_oid(bucket, key)
+        # replace semantics: the old entry's data (incl. multipart
+        # manifest parts) dies with the overwrite
+        await self._purge_data(bucket, key,
+                               await self._old_entry(bucket_name, key))
+        if data:
+            await self.striper.write(soid, data, 0)
+        etag = hashlib.md5(data).hexdigest()
+        entry = {"size": len(data), "etag": etag, "mtime": _now_iso(),
+                 "owner": owner, "content_type": content_type,
+                 "meta": meta or {}}
+        await self.ioctx.exec(idx, "rgw_index", "complete", json.dumps(
+            {"tag": tag, "key": key, "entry": entry}).encode())
+        return entry
+
+    async def put_object_manifest(self, bucket_name: str, key: str,
+                                  parts: list[dict], owner: str,
+                                  content_type: str, etag: str,
+                                  meta: dict | None = None) -> dict:
+        """Link a multipart manifest as the object (complete-upload)."""
+        bucket = await self.get_bucket(bucket_name)
+        old = await self._old_entry(bucket_name, key)
+        if old is not None:
+            await self._purge_data(bucket, key, old)
+        size = sum(p["size"] for p in parts)
+        entry = {"size": size, "etag": etag, "mtime": _now_iso(),
+                 "owner": owner, "content_type": content_type,
+                 "meta": meta or {},
+                 "manifest": [{"oid": p["oid"], "size": p["size"]}
+                              for p in parts]}
+        await self.ioctx.exec(
+            self._index(bucket), "rgw_index", "complete",
+            json.dumps({"key": key, "entry": entry}).encode())
+        return entry
+
+    async def get_entry(self, bucket_name: str, key: str) -> dict:
+        bucket = await self.get_bucket(bucket_name)
+        try:
+            raw = await self.ioctx.exec(
+                self._index(bucket), "rgw_index", "get",
+                json.dumps({"key": key}).encode())
+        except RadosError as e:
+            raise RgwError("NoSuchKey", 404, key) from e
+        return json.loads(raw)
+
+    async def get_object(self, bucket_name: str, key: str,
+                         off: int = 0,
+                         length: int | None = None) -> tuple[dict, bytes]:
+        bucket = await self.get_bucket(bucket_name)
+        entry = await self.get_entry(bucket_name, key)
+        if length is None:
+            length = entry["size"] - off
+        length = max(0, min(length, entry["size"] - off))
+        if "manifest" in entry:
+            data = await self._read_manifest(entry["manifest"], off,
+                                             length)
+        else:
+            data = await self.striper.read(
+                self._data_oid(bucket, key), length, off)
+        return entry, data
+
+    async def _read_manifest(self, manifest: list[dict], off: int,
+                             length: int) -> bytes:
+        out = []
+        pos = 0
+        for part in manifest:
+            pend = pos + part["size"]
+            if pend > off and pos < off + length:
+                s = max(0, off - pos)
+                n = min(part["size"], off + length - pos) - s
+                out.append(await self.striper.read(part["oid"], n, s))
+            pos = pend
+            if pos >= off + length:
+                break
+        return b"".join(out)
+
+    async def delete_object(self, bucket_name: str, key: str) -> None:
+        bucket = await self.get_bucket(bucket_name)
+        try:
+            entry = await self.get_entry(bucket_name, key)
+        except RgwError:
+            return                        # S3 DELETE is idempotent
+        await self.ioctx.exec(
+            self._index(bucket), "rgw_index", "unlink",
+            json.dumps({"key": key}).encode())
+        await self._purge_data(bucket, key, entry)
+
+    async def list_objects(self, bucket_name: str, prefix: str = "",
+                           marker: str = "", max_keys: int = 1000,
+                           delimiter: str = "") -> dict:
+        bucket = await self.get_bucket(bucket_name)
+        entries: list[list] = []
+        prefixes: set[str] = set()
+        truncated = False
+        cursor = marker
+        while True:
+            raw = json.loads(await self.ioctx.exec(
+                self._index(bucket), "rgw_index", "list",
+                json.dumps({"prefix": prefix, "marker": cursor,
+                            "max": max_keys + 1}).encode()))
+            page = raw["entries"]
+            if not page:
+                truncated = False
+                break
+            full = False
+            for i, (k, e) in enumerate(page):
+                cursor = k
+                if delimiter:
+                    rest = k[len(prefix):]
+                    if delimiter in rest:
+                        prefixes.add(
+                            prefix + rest.split(delimiter)[0] + delimiter)
+                        continue
+                entries.append([k, e])
+                if len(entries) >= max_keys:
+                    # more results iff the page has unconsumed items
+                    # or the index said there are further pages
+                    truncated = (i + 1 < len(page)
+                                 or bool(raw["truncated"]))
+                    full = True
+                    break
+            if full:
+                break
+            if not raw["truncated"]:
+                truncated = False
+                break
+        return {"entries": entries, "truncated": truncated,
+                "prefixes": sorted(prefixes),
+                "next_marker": entries[-1][0] if entries else ""}
+
+    # -- multipart ----------------------------------------------------------
+    async def initiate_multipart(self, bucket_name: str, key: str,
+                                 owner: str,
+                                 content_type: str = "") -> str:
+        bucket = await self.get_bucket(bucket_name)
+        upload_id = os.urandom(12).hex()
+        await self.ioctx.set_omap(
+            f"rgw_uploads.{bucket['id']}",
+            {upload_id: json.dumps({
+                "key": key, "owner": owner,
+                "content_type": content_type,
+                "started": _now_iso()}).encode()})
+        return upload_id
+
+    async def _upload_meta(self, bucket: dict, upload_id: str) -> dict:
+        try:
+            omap = await self.ioctx.get_omap(
+                f"rgw_uploads.{bucket['id']}")
+        except RadosError:
+            omap = {}
+        raw = omap.get(upload_id)
+        if raw is None:
+            raise RgwError("NoSuchUpload", 404, upload_id)
+        return json.loads(raw)
+
+    async def put_part(self, bucket_name: str, key: str, upload_id: str,
+                       part_number: int, data: bytes) -> dict:
+        bucket = await self.get_bucket(bucket_name)
+        await self._upload_meta(bucket, upload_id)
+        oid = self._part_oid(bucket, key, upload_id, part_number)
+        await self.striper.remove(oid)
+        await self.striper.write(oid, data, 0)
+        # record the part so abort can find EXACTLY the uploaded parts
+        # (a dense 1..n probe loses parts after a gap)
+        await self.ioctx.set_omap(
+            f"rgw_uploads.{bucket['id']}",
+            {f"{upload_id}.part.{part_number}":
+             str(len(data)).encode()})
+        return {"etag": hashlib.md5(data).hexdigest(),
+                "size": len(data), "oid": oid}
+
+    async def complete_multipart(self, bucket_name: str, key: str,
+                                 upload_id: str,
+                                 part_numbers: list[int]) -> dict:
+        bucket = await self.get_bucket(bucket_name)
+        up = await self._upload_meta(bucket, upload_id)
+        parts = []
+        md5s = []
+        for n in part_numbers:
+            oid = self._part_oid(bucket, key, upload_id, n)
+            size = await self.striper.size(oid)
+            if size == 0:
+                raise RgwError("InvalidPart", 400, f"part {n}")
+            buf = await self.striper.read(oid)
+            md5s.append(hashlib.md5(buf).digest())
+            parts.append({"oid": oid, "size": size})
+        etag = (hashlib.md5(b"".join(md5s)).hexdigest()
+                + f"-{len(parts)}")
+        entry = await self.put_object_manifest(
+            bucket_name, key, parts, up["owner"], up["content_type"],
+            etag)
+        uploaded = await self._uploaded_parts(bucket, upload_id)
+        # parts uploaded but not referenced by the manifest (retries,
+        # gaps, unused numbers) are garbage now
+        for n in set(uploaded) - set(part_numbers):
+            await self.striper.remove(
+                self._part_oid(bucket, key, upload_id, n))
+        await self.ioctx.rm_omap_keys(
+            f"rgw_uploads.{bucket['id']}",
+            [upload_id] + [f"{upload_id}.part.{n}" for n in uploaded])
+        return entry
+
+    async def _uploaded_parts(self, bucket: dict,
+                              upload_id: str) -> list[int]:
+        try:
+            omap = await self.ioctx.get_omap(
+                f"rgw_uploads.{bucket['id']}")
+        except RadosError:
+            return []
+        pre = f"{upload_id}.part."
+        return sorted(int(k[len(pre):]) for k in omap
+                      if k.startswith(pre))
+
+    async def abort_multipart(self, bucket_name: str, key: str,
+                              upload_id: str) -> None:
+        bucket = await self.get_bucket(bucket_name)
+        await self._upload_meta(bucket, upload_id)
+        parts = await self._uploaded_parts(bucket, upload_id)
+        for n in parts:
+            await self.striper.remove(
+                self._part_oid(bucket, key, upload_id, n))
+        await self.ioctx.rm_omap_keys(
+            f"rgw_uploads.{bucket['id']}",
+            [upload_id] + [f"{upload_id}.part.{n}" for n in parts])

@@ -1,0 +1,10 @@
+"""MDS + CephFS analog: metadata daemon, journal, POSIX-ish client.
+
+Reference: src/mds (Server.cc client RPC, MDLog/Journaler metadata
+journal, MDCache dirfrag storage), src/client (libcephfs).
+"""
+
+from .server import MDS
+from .client import CephFS, FsError
+
+__all__ = ["MDS", "CephFS", "FsError"]

@@ -1,0 +1,226 @@
+"""CephFS client (libcephfs / src/client/Client.cc analog).
+
+POSIX-ish surface: mkdir/rmdir/readdir/stat/open/read/write/truncate/
+unlink/rename.  Metadata RPCs go to the active MDS (discovered from
+the mds_map object, re-resolved on failure -- the FSMap subscription
+analog); file DATA goes straight to the data pool through the striper
+with the layout from the inode, never through the MDS.  File size is
+write-back: the client tracks it per open file and flushes a setattr
+on close/fsync (the Fw cap dirty-size flush)."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+
+from ..client.rados import Rados, RadosError
+from ..client.striper import Layout, RadosStriper
+from ..msg import Message
+from .server import DEFAULT_LAYOUT, MDSMAP_OID
+
+
+class FsError(Exception):
+    def __init__(self, errno_name: str, detail: str = "") -> None:
+        super().__init__(f"{errno_name}{': ' + detail if detail else ''}")
+        self.errno_name = errno_name
+
+
+class FsFile:
+    """An open file handle."""
+
+    def __init__(self, fs: "CephFS", path: str, dentry: dict) -> None:
+        self.fs = fs
+        self.path = path
+        self.dentry = dentry
+        self.ino = dentry["ino"]
+        lay = dentry.get("layout") or DEFAULT_LAYOUT
+        self.striper = RadosStriper(fs.data, Layout(
+            stripe_unit=lay["su"], stripe_count=lay["sc"],
+            object_size=lay["os"]))
+        self.size = dentry.get("size", 0)
+        self._dirty = False
+        self._closed = False
+
+    async def write(self, data: bytes, offset: int = 0) -> int:
+        await self.striper.write(f"{self.ino:x}", data, offset)
+        self.size = max(self.size, offset + len(data))
+        self._dirty = True
+        return len(data)
+
+    async def read(self, length: int | None = None,
+                   offset: int = 0) -> bytes:
+        return await self.striper.read(f"{self.ino:x}", length, offset)
+
+    async def truncate(self, size: int) -> None:
+        await self.striper.truncate(f"{self.ino:x}", size)
+        self.size = size
+        self._dirty = True
+
+    async def fsync(self) -> None:
+        if self._dirty:
+            await self.fs._request({"op": "setattr", "path": self.path,
+                                    "attrs": {"size": self.size}})
+            self._dirty = False
+
+    async def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            await self.fsync()
+
+
+class CephFS:
+    """Mounted filesystem handle (ceph_mount analog)."""
+
+    def __init__(self, mon_addr: tuple[str, int],
+                 meta_pool: str = "cephfs_metadata",
+                 data_pool: str = "cephfs_data",
+                 name: str | None = None) -> None:
+        self.mon_addr = tuple(mon_addr)
+        self.meta_pool = meta_pool
+        self.data_pool = data_pool
+        self.rados = Rados(mon_addr, name=name)
+        self.meta = None
+        self.data = None
+        self.mds_addr: tuple[str, int] | None = None
+        self._tid = itertools.count(1)
+        self._waiters: dict[int, asyncio.Future] = {}
+
+    async def mount(self) -> "CephFS":
+        await self.rados.connect()
+        self.meta = await self.rados.open_ioctx(self.meta_pool)
+        self.data = await self.rados.open_ioctx(self.data_pool)
+        self.rados.objecter.msgr.add_dispatcher(self._on_reply)
+        await self._find_mds()
+        return self
+
+    async def unmount(self) -> None:
+        await self.rados.shutdown()
+
+    async def _find_mds(self, timeout: float = 30.0) -> None:
+        """Resolve the active MDS address from mds_map (FSMap)."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
+            try:
+                omap = await self.meta.get_omap(MDSMAP_OID)
+                raw = omap.get("addr")
+                if raw:
+                    self.mds_addr = tuple(json.loads(raw))
+                    return
+            except RadosError:
+                pass
+            await asyncio.sleep(0.5)
+        raise FsError("ETIMEDOUT", "no active mds")
+
+    async def _on_reply(self, conn, msg: Message) -> None:
+        if msg.type != "mds_reply":
+            return
+        fut = self._waiters.pop(msg.data.get("tid"), None)
+        if fut is not None and not fut.done():
+            fut.set_result(msg.data)
+
+    async def _request(self, q: dict, timeout: float = 30.0) -> dict:
+        """RPC to the active MDS; re-resolves on failure (the client's
+        session reconnect to the new active after failover)."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        last: Exception | None = None
+        # the reqid is STABLE across resends of this logical op (the
+        # per-attempt tid is not): the MDS dedups a mutation whose
+        # reply was lost instead of re-applying it (mkdir resent after
+        # a failover must not surface EEXIST)
+        reqid = f"{self.rados.objecter.msgr.name}:{next(self._tid)}"
+        while loop.time() < deadline:
+            tid = next(self._tid)
+            fut = loop.create_future()
+            self._waiters[tid] = fut
+            try:
+                await self.rados.objecter.msgr.send(
+                    self.mds_addr, "mds", Message(
+                        "mds_request",
+                        {**q, "tid": tid, "reqid": reqid}))
+                out = await asyncio.wait_for(fut, 5.0)
+                if out.get("err") == "EAGAIN":       # standby answered
+                    raise ConnectionError("mds not active")
+                if "err" in out:
+                    raise FsError(out["err"], out.get("detail", ""))
+                return out
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                last = e
+                self._waiters.pop(tid, None)
+                await asyncio.sleep(0.5)
+                try:
+                    await self._find_mds(timeout=5.0)
+                except FsError:
+                    pass
+        raise FsError("ETIMEDOUT", f"mds unreachable: {last}")
+
+    # -- namespace ops ------------------------------------------------------
+    async def mkdir(self, path: str, mode: int = 0o755) -> None:
+        await self._request({"op": "mkdir", "path": path, "mode": mode})
+
+    async def rmdir(self, path: str) -> None:
+        await self._request({"op": "rmdir", "path": path})
+
+    async def readdir(self, path: str = "/") -> dict[str, dict]:
+        out = await self._request({"op": "readdir", "path": path})
+        return out["entries"]
+
+    async def ls(self, path: str = "/") -> list[str]:
+        return sorted(await self.readdir(path))
+
+    async def stat(self, path: str) -> dict:
+        out = await self._request({"op": "stat", "path": path})
+        return out["dentry"]
+
+    async def exists(self, path: str) -> bool:
+        try:
+            await self.stat(path)
+            return True
+        except FsError as e:
+            if e.errno_name == "ENOENT":
+                return False
+            raise
+
+    async def unlink(self, path: str) -> None:
+        await self._request({"op": "unlink", "path": path})
+
+    async def rename(self, src: str, dst: str) -> None:
+        await self._request({"op": "rename", "path": src, "dst": dst})
+
+    async def open(self, path: str, flags: str = "r",
+                   mode: int = 0o644) -> FsFile:
+        create = "w" in flags or "a" in flags or "+" in flags
+        out = await self._request({"op": "open", "path": path,
+                                   "create": create, "mode": mode})
+        f = FsFile(self, path, out["dentry"])
+        if "w" in flags and "+" not in flags:
+            await f.truncate(0)
+        return f
+
+    # -- convenience --------------------------------------------------------
+    async def write_file(self, path: str, data: bytes) -> None:
+        f = await self.open(path, "w")
+        try:
+            await f.write(data, 0)
+        finally:
+            await f.close()
+
+    async def read_file(self, path: str) -> bytes:
+        f = await self.open(path, "r")
+        try:
+            return await f.read()
+        finally:
+            await f.close()
+
+    async def walk(self, path: str = "/"):
+        """Yield (dirpath, dirnames, filenames) depth-first."""
+        entries = await self.readdir(path)
+        dirs = [n for n, d in entries.items() if d["type"] == "dir"]
+        files = [n for n, d in entries.items() if d["type"] == "file"]
+        yield path, sorted(dirs), sorted(files)
+        for d in sorted(dirs):
+            sub = f"{path.rstrip('/')}/{d}"
+            async for x in self.walk(sub):
+                yield x

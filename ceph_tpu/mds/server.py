@@ -1,0 +1,491 @@
+"""MDS daemon: filesystem metadata service over RADOS.
+
+A compressed rendering of src/mds:
+
+  * Dirfrag storage: one metadata-pool object per directory
+    (``dir.<ino:016x>``), dentries in its omap with the inode EMBEDDED
+    in the primary dentry -- exactly Ceph's on-disk choice
+    (CDir/CDentry/CInode, src/mds/CDir.cc commit path).
+  * Every mutation journals an event first (journal.py; MDLog::submit),
+    then applies write-through to the dirfrag omap; replay re-applies
+    the crash window idempotently.
+  * Client RPC over the messenger mirrors Server::handle_client_request
+    (src/mds/Server.cc:2520): path-resolve, mutate, reply with the
+    dentry/inode.  File DATA never touches the MDS -- clients stripe
+    it straight to the data pool (the layout rides in the inode), the
+    defining CephFS data path split.
+  * Single active MDS with hot standby: activation is an exclusive
+    cls_lock on the ``mds_map`` object (+ renewal); the standby polls,
+    wins the lock on holder death, replays the journal, and publishes
+    its address in mds_map -- MDSMonitor/FSMap failover compressed to
+    a lock (no mon involvement).
+  * unlink purges file data through the striper after the journal
+    commits (PurgeQueue analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from ..client.rados import IoCtx, Rados, RadosError
+from ..client.striper import Layout, RadosStriper
+from ..msg import Message, Messenger
+from .journal import Journal
+
+ROOT_INO = 1
+MDSMAP_OID = "mds_map"
+INOTABLE_OID = "mds_inotable"
+LOCK_NAME = "mds_active"
+LOCK_DURATION = 6.0
+LOCK_RENEW = 2.0
+TRIM_EVERY = 64
+
+DEFAULT_LAYOUT = {"su": 1 << 22, "sc": 1, "os": 1 << 22}
+
+
+def dir_oid(ino: int) -> str:
+    return f"dir.{ino:016x}"
+
+
+def _now() -> float:
+    return time.time()
+
+
+class MDS:
+    def __init__(self, name: str = "a",
+                 meta_pool: str = "cephfs_metadata",
+                 data_pool: str = "cephfs_data") -> None:
+        self.name = name
+        self.meta_pool = meta_pool
+        self.data_pool = data_pool
+        self.msgr = Messenger(f"mds.{name}")
+        self.rados: Rados | None = None
+        self.meta: IoCtx | None = None
+        self.data: IoCtx | None = None
+        self.journal: Journal | None = None
+        self.state = "standby"
+        self.addr: tuple[str, int] | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._next_ino = ROOT_INO + 1
+        self._events_since_trim = 0
+        self._lock = asyncio.Lock()       # one mutation at a time
+        # reqid -> reply: lets a client safely RESEND a mutation whose
+        # reply was lost (mkdir retried after an MDS death must not
+        # surface EEXIST).  Rebuilt from the journal window on replay,
+        # so dedup survives failover for as long as the pg-log-style
+        # trim window (the reference replays its session table)
+        self._completed: dict[str, dict] = {}
+        self._stopped = False
+        self.msgr.add_dispatcher(self._dispatch)
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self, mon_addr: tuple[str, int],
+                    create_pools: bool = True) -> tuple[str, int]:
+        self.rados = await Rados(mon_addr, name=f"mds.{self.name}"
+                                 ).connect()
+        pools = await self.rados.pool_list()
+        if create_pools:
+            for p in (self.meta_pool, self.data_pool):
+                if p not in pools:
+                    await self.rados.pool_create(p, pg_num=8)
+        self.meta = await self.rados.open_ioctx(self.meta_pool)
+        self.data = await self.rados.open_ioctx(self.data_pool)
+        self.journal = Journal(self.meta)
+        self.addr = await self.msgr.bind()
+        t = asyncio.ensure_future(self._standby_loop())
+        self._tasks.append(t)
+        return self.addr
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self.state == "active":
+            try:
+                await self.meta.exec(MDSMAP_OID, "lock", "unlock",
+                                     json.dumps({"name": LOCK_NAME,
+                                                 "cookie": self.name}
+                                                ).encode())
+            except (RadosError, ConnectionError, OSError):
+                pass
+        await self.msgr.shutdown()
+        if self.rados:
+            await self.rados.shutdown()
+
+    # -- standby -> active (FSMap failover via lock) -------------------------
+    async def _standby_loop(self) -> None:
+        try:
+            while not self._stopped:
+                try:
+                    await self.meta.exec(
+                        MDSMAP_OID, "lock", "lock", json.dumps({
+                            "name": LOCK_NAME, "type": "exclusive",
+                            "cookie": self.name,
+                            "duration": LOCK_DURATION,
+                            "flags": 1}).encode())
+                except RadosError:
+                    await asyncio.sleep(1.0)
+                    continue
+                await self._become_active()
+                last_renew = asyncio.get_event_loop().time()
+                while not self._stopped:      # renewal loop
+                    await asyncio.sleep(LOCK_RENEW)
+                    try:
+                        await self.meta.exec(
+                            MDSMAP_OID, "lock", "lock", json.dumps({
+                                "name": LOCK_NAME, "type": "exclusive",
+                                "cookie": self.name,
+                                "duration": LOCK_DURATION,
+                                "flags": 1}).encode())
+                        last_renew = asyncio.get_event_loop().time()
+                    except (RadosError, ConnectionError, OSError) as e:
+                        # losing the lock means a standby may be (or
+                        # become) active: serving on is split-brain.
+                        # EBUSY = someone else holds it: demote NOW;
+                        # transient errors demote once the lease the
+                        # peer waits out has certainly lapsed.
+                        held_for = (asyncio.get_event_loop().time()
+                                    - last_renew)
+                        if (getattr(e, "errno_name", "") == "EBUSY"
+                                or held_for > LOCK_DURATION):
+                            self.state = "standby"
+                            break
+        except asyncio.CancelledError:
+            pass
+
+    async def _become_active(self) -> None:
+        await self.journal.load()
+        async for ev in self.journal.replay():   # crash-window replay
+            await self._apply_event(ev, replay=True)
+            if ev.get("reqid"):
+                self._remember(ev["reqid"], ev.get("reply", {}))
+        await self.journal.trim()
+        await self._load_inotable()
+        # ensure the root dirfrag exists
+        try:
+            await self.meta.stat(dir_oid(ROOT_INO))
+        except RadosError:
+            await self.meta.write_full(dir_oid(ROOT_INO), b"")
+        await self.meta.set_omap(MDSMAP_OID, {
+            "addr": json.dumps(list(self.addr)).encode(),
+            "name": self.name.encode(),
+            "epoch": str(int(_now())).encode()})
+        self.state = "active"
+
+    async def _load_inotable(self) -> None:
+        try:
+            omap = await self.meta.get_omap(INOTABLE_OID)
+            self._next_ino = int(omap.get("next_ino",
+                                          str(ROOT_INO + 1).encode()))
+        except RadosError:
+            self._next_ino = ROOT_INO + 1
+
+    async def _alloc_ino(self) -> int:
+        ino = self._next_ino
+        self._next_ino += 1
+        await self.meta.set_omap(INOTABLE_OID, {
+            "next_ino": str(self._next_ino).encode()})
+        return ino
+
+    # -- dirfrag access -----------------------------------------------------
+    async def _dentries(self, ino: int) -> dict[str, dict]:
+        try:
+            omap = await self.meta.get_omap(dir_oid(ino))
+        except RadosError:
+            return {}
+        return {k: json.loads(v) for k, v in omap.items()}
+
+    async def _lookup_dentry(self, ino: int, name: str) -> dict | None:
+        d = await self._dentries(ino)
+        return d.get(name)
+
+    async def _resolve_inos(self, path: str) -> list[int]:
+        """Directory-ino chain from root down to (and including) the
+        path's directory components -- the ancestor set a rename must
+        check against."""
+        parts = [p for p in path.split("/") if p]
+        chain = [ROOT_INO]
+        ino = ROOT_INO
+        for name in parts[:-1]:
+            child = await self._lookup_dentry(ino, name)
+            if child is None or child["type"] != "dir":
+                raise FsOpError("ENOENT", path)
+            ino = child["ino"]
+            chain.append(ino)
+        return chain
+
+    async def _resolve(self, path: str,
+                       want_parent: bool = False):
+        """Walk the path from root. Returns (ino, dentry|None) or, with
+        want_parent, (parent_ino, leaf_name, dentry|None)."""
+        parts = [p for p in path.split("/") if p]
+        ino = ROOT_INO
+        dent = {"ino": ROOT_INO, "type": "dir", "mode": 0o755}
+        for i, name in enumerate(parts):
+            last = i == len(parts) - 1
+            if dent["type"] != "dir":
+                raise FsOpError("ENOTDIR", "/".join(parts[:i]))
+            child = await self._lookup_dentry(ino, name)
+            if last and want_parent:
+                return ino, name, child
+            if child is None:
+                raise FsOpError("ENOENT", "/".join(parts[:i + 1]))
+            ino, dent = child["ino"], child
+        if want_parent:
+            if not parts:
+                raise FsOpError("EINVAL", "root has no parent")
+            return None                    # unreachable
+        return ino, dent
+
+    # -- journal + apply ----------------------------------------------------
+    async def _journal_and_apply(self, ev: dict,
+                                 reqid: str | None = None,
+                                 reply: dict | None = None) -> None:
+        if reqid is not None:
+            ev = {**ev, "reqid": reqid, "reply": reply or {}}
+        await self.journal.append(ev)
+        await self._apply_event(ev)
+        if reqid is not None:
+            self._remember(reqid, reply or {})
+        self._events_since_trim += 1
+        if self._events_since_trim >= TRIM_EVERY:
+            # write-through: everything journaled is already applied
+            self._events_since_trim = 0
+            await self.journal.trim()
+
+    def _remember(self, reqid: str, reply: dict) -> None:
+        self._completed[reqid] = reply
+        while len(self._completed) > 4096:
+            self._completed.pop(next(iter(self._completed)))
+
+    async def _apply_event(self, ev: dict, replay: bool = False) -> None:
+        op = ev["op"]
+        if op == "link":
+            await self.meta.set_omap(dir_oid(ev["dir"]), {
+                ev["name"]: json.dumps(ev["dentry"]).encode()})
+            if ev["dentry"]["type"] == "dir" and ev.get("mkdir"):
+                try:
+                    await self.meta.stat(dir_oid(ev["dentry"]["ino"]))
+                except RadosError:
+                    await self.meta.write_full(
+                        dir_oid(ev["dentry"]["ino"]), b"")
+        elif op == "unlink":
+            try:
+                await self.meta.rm_omap_keys(dir_oid(ev["dir"]),
+                                             [ev["name"]])
+            except RadosError:
+                pass
+            if ev.get("rmdir_ino"):
+                try:
+                    await self.meta.remove(dir_oid(ev["rmdir_ino"]))
+                except RadosError:
+                    pass
+            if ev.get("purge"):
+                # purge rides the event so a crash between journal
+                # commit and data removal re-purges on replay (the
+                # reference's PurgeQueue is durable for the same reason)
+                await self._purge_file(ev["purge"])
+        elif op == "rename":
+            # one event, two dirfrag updates: replay makes the pair
+            # atomic-on-crash (EMetaBlob touching two dirs)
+            await self.meta.set_omap(dir_oid(ev["dst_dir"]), {
+                ev["dst_name"]: json.dumps(ev["dentry"]).encode()})
+            if (ev["src_dir"], ev["src_name"]) != (ev["dst_dir"],
+                                                  ev["dst_name"]):
+                try:
+                    await self.meta.rm_omap_keys(dir_oid(ev["src_dir"]),
+                                                 [ev["src_name"]])
+                except RadosError:
+                    pass
+            if ev.get("rmdir_ino"):       # dir replaced by the rename
+                try:
+                    await self.meta.remove(dir_oid(ev["rmdir_ino"]))
+                except RadosError:
+                    pass
+            if ev.get("purge"):           # file replaced by the rename
+                await self._purge_file(ev["purge"])
+        elif op == "setattr":
+            dent = await self._lookup_dentry(ev["dir"], ev["name"])
+            if dent is not None and (replay is False
+                                     or dent["ino"] == ev["ino"]):
+                dent.update(ev["attrs"])
+                await self.meta.set_omap(dir_oid(ev["dir"]), {
+                    ev["name"]: json.dumps(dent).encode()})
+
+    # -- purge (PurgeQueue) --------------------------------------------------
+    async def _purge_file(self, dent: dict) -> None:
+        lay = dent.get("layout", DEFAULT_LAYOUT)
+        striper = RadosStriper(self.data, Layout(
+            stripe_unit=lay["su"], stripe_count=lay["sc"],
+            object_size=lay["os"]))
+        try:
+            await striper.remove(f"{dent['ino']:x}")
+        except RadosError:
+            pass
+
+    # -- client RPC ----------------------------------------------------------
+    async def _dispatch(self, conn, msg: Message) -> None:
+        if msg.type != "mds_request":
+            return
+        try:
+            if self.state != "active":
+                out = {"err": "EAGAIN", "detail": "mds not active"}
+            else:
+                out = await self._handle(msg.data)
+        except FsOpError as e:
+            out = {"err": e.errno_name, "detail": e.detail}
+        except (RadosError, asyncio.TimeoutError) as e:
+            out = {"err": "EIO", "detail": str(e)}
+        try:
+            await conn.send(Message("mds_reply",
+                                    {"tid": msg.data.get("tid"), **out}))
+        except (ConnectionError, OSError):
+            pass
+
+    async def _handle(self, q: dict) -> dict:
+        op = q["op"]
+        path = q.get("path", "/")
+        if op in ("mkdir", "create", "unlink", "rmdir", "rename",
+                  "setattr"):
+            async with self._lock:
+                reqid = q.get("reqid")
+                if reqid and reqid in self._completed:
+                    # lost-reply resend: acknowledge, don't re-apply
+                    return dict(self._completed[reqid])
+                out = await self._handle_mutation(op, path, q)
+                return out
+        if op == "lookup" or op == "stat":
+            if path.strip("/") == "":
+                return {"dentry": {"ino": ROOT_INO, "type": "dir",
+                                   "mode": 0o755}}
+            _, dent = await self._resolve(path)
+            return {"dentry": dent}
+        if op == "readdir":
+            if path.strip("/") == "":
+                ino = ROOT_INO
+            else:
+                ino, dent = await self._resolve(path)
+                if dent["type"] != "dir":
+                    raise FsOpError("ENOTDIR", path)
+            return {"entries": await self._dentries(ino)}
+        if op == "open":
+            parent, name, dent = await self._resolve(path,
+                                                     want_parent=True)
+            if dent is None:
+                if not q.get("create"):
+                    raise FsOpError("ENOENT", path)
+                async with self._lock:
+                    return await self._handle_mutation("create", path, q)
+            if dent["type"] == "dir":
+                raise FsOpError("EISDIR", path)
+            return {"dentry": dent, "parent": parent, "name": name,
+                    "caps": "pAsLsXsFsrw"}
+        raise FsOpError("EOPNOTSUPP", op)
+
+    async def _handle_mutation(self, op: str, path: str,
+                               q: dict) -> dict:
+        reqid = q.get("reqid")
+        if op in ("mkdir", "create"):
+            parent, name, existing = await self._resolve(
+                path, want_parent=True)
+            if existing is not None:
+                if op == "create" and q.get("create") \
+                        and existing["type"] == "file" \
+                        and not q.get("excl"):
+                    return {"dentry": existing, "parent": parent,
+                            "name": name, "caps": "pAsLsXsFsrw"}
+                raise FsOpError("EEXIST", path)
+            ino = await self._alloc_ino()
+            dent = {"ino": ino,
+                    "type": "dir" if op == "mkdir" else "file",
+                    "mode": q.get("mode",
+                                  0o755 if op == "mkdir" else 0o644),
+                    "size": 0, "mtime": _now(),
+                    "ctime": _now()}
+            if op == "create":
+                dent["layout"] = q.get("layout", DEFAULT_LAYOUT)
+            reply = {"dentry": dent, "parent": parent, "name": name,
+                     "caps": "pAsLsXsFsrw"}
+            await self._journal_and_apply({
+                "op": "link", "dir": parent, "name": name,
+                "dentry": dent, "mkdir": op == "mkdir"}, reqid, reply)
+            return reply
+        if op in ("unlink", "rmdir"):
+            parent, name, dent = await self._resolve(path,
+                                                     want_parent=True)
+            if dent is None:
+                raise FsOpError("ENOENT", path)
+            if op == "rmdir":
+                if dent["type"] != "dir":
+                    raise FsOpError("ENOTDIR", path)
+                if await self._dentries(dent["ino"]):
+                    raise FsOpError("ENOTEMPTY", path)
+            elif dent["type"] == "dir":
+                raise FsOpError("EISDIR", path)
+            await self._journal_and_apply({
+                "op": "unlink", "dir": parent, "name": name,
+                "rmdir_ino": dent["ino"] if op == "rmdir" else 0,
+                "purge": dent if op == "unlink" else None},
+                reqid, {})
+            return {}
+        if op == "rename":
+            src_parent, src_name, dent = await self._resolve(
+                path, want_parent=True)
+            if dent is None:
+                raise FsOpError("ENOENT", path)
+            dst_parent, dst_name, dst_dent = await self._resolve(
+                q["dst"], want_parent=True)
+            if dent["type"] == "dir":
+                # a directory must not move into its own subtree: the
+                # dirfrag would link to itself and the subtree would
+                # drop out of the namespace forever
+                if dent["ino"] in await self._resolve_inos(q["dst"]):
+                    raise FsOpError("EINVAL",
+                                    "cannot move a directory into "
+                                    "its own subtree")
+            if dst_dent is not None:
+                if dst_dent["type"] == "dir":
+                    if dent["type"] != "dir":
+                        raise FsOpError("EISDIR", q["dst"])
+                    if await self._dentries(dst_dent["ino"]):
+                        raise FsOpError("ENOTEMPTY", q["dst"])
+                elif dent["type"] == "dir":
+                    raise FsOpError("ENOTDIR", q["dst"])
+            replaced_dir = (dst_dent["ino"]
+                            if dst_dent and dst_dent["type"] == "dir"
+                            else 0)
+            replaced_file = (dst_dent
+                             if dst_dent and dst_dent["type"] == "file"
+                             else None)
+            await self._journal_and_apply({
+                "op": "rename", "src_dir": src_parent,
+                "src_name": src_name, "dst_dir": dst_parent,
+                "dst_name": dst_name, "dentry": dent,
+                "rmdir_ino": replaced_dir, "purge": replaced_file},
+                reqid, {"dentry": dent})
+            return {"dentry": dent}
+        if op == "setattr":
+            parent, name, dent = await self._resolve(path,
+                                                     want_parent=True)
+            if dent is None:
+                raise FsOpError("ENOENT", path)
+            attrs = {k: v for k, v in q.get("attrs", {}).items()
+                     if k in ("size", "mode", "mtime")}
+            attrs["ctime"] = _now()
+            dent.update(attrs)
+            await self._journal_and_apply({
+                "op": "setattr", "dir": parent, "name": name,
+                "ino": dent["ino"], "attrs": attrs},
+                reqid, {"dentry": dent})
+            return {"dentry": dent}
+        raise FsOpError("EOPNOTSUPP", op)
+
+
+class FsOpError(Exception):
+    def __init__(self, errno_name: str, detail: str = "") -> None:
+        super().__init__(f"{errno_name}: {detail}")
+        self.errno_name = errno_name
+        self.detail = detail

@@ -55,8 +55,16 @@ async def run_cluster(args) -> None:
         mgr = Mgr(config={"balancer_active": True})
         await mgr.start(addr)
         print("mgr.x active (balancer on)", flush=True)
+    mdss = []
+    for i in range(args.mds):
+        from ..mds import MDS
+        m = MDS(name=chr(ord("a") + i))
+        await m.start(addr)
+        mdss.append(m)
+        print(f"mds.{m.name} up (standby)", flush=True)
     print(f"cluster ready: 1 mon, {len(osds)} osds"
-          f"{', 1 mgr' if mgr else ''} -- "
+          f"{', 1 mgr' if mgr else ''}"
+          f"{f', {len(mdss)} mds' if mdss else ''} -- "
           f"rados -m {addr[0]}:{addr[1]} lspools", flush=True)
 
     stop = asyncio.Event()
@@ -65,6 +73,8 @@ async def run_cluster(args) -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     print("shutting down...", flush=True)
+    for m in mdss:
+        await m.stop()
     if mgr is not None:
         await mgr.stop()
     for osd in osds:
@@ -78,6 +88,8 @@ def main(argv=None) -> int:
     p.add_argument("--hosts", type=int, default=3,
                    help="spread OSDs over N crush hosts")
     p.add_argument("--mon-port", type=int, default=6789)
+    p.add_argument("--mds", type=int, default=0,
+                   help="start N metadata servers (cephfs)")
     p.add_argument("--store-dir", default=None,
                    help="directory for durable SQLite stores")
     p.add_argument("--asok-dir", default=None,

@@ -1,0 +1,141 @@
+"""GF(2) bit-matrix codec: the jerasure bitmatrix-technique data path.
+
+jerasure's cauchy/liberation/blaum_roth techniques encode by XORing
+w-bit packet rows selected by a (m*w, k*w) GF(2) matrix
+(jerasure_bitmatrix_encode): each chunk is a sequence of regions of
+w * packetsize bytes; packet row c of region g of chunk j is plane
+(j*w + c); coding plane r = XOR of the data planes with a 1 in
+bitmatrix row r.  Decode inverts the (k*w)-square submatrix of
+surviving generator rows over GF(2).
+
+The XOR formulation is exactly the GF(2) bit-matmul the TPU kernel
+family runs on the MXU (ops/gf2kernels.py) -- same math, different
+plane granularity (w-bit packets instead of bit planes).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Mapping
+
+import numpy as np
+
+from ..gf.gf2w import gf2_invert, xor_matmul
+from .base import ErasureCode
+
+
+class BitMatrixCodec(ErasureCode):
+    """Systematic (k+m, k) code defined by a (m*w, k*w) GF(2) matrix.
+
+    Subclasses set self.k/self.m/self.w/self.packetsize and build
+    self.bitmatrix in prepare()."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.k = 0
+        self.m = 0
+        self.w = 8
+        self.packetsize = 8
+        self.bitmatrix: np.ndarray | None = None
+        self._inv_cache: OrderedDict[str, tuple] = OrderedDict()
+
+    # -- geometry -----------------------------------------------------------
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        # chunk must hold whole regions of w*packetsize bytes
+        # (ErasureCodeJerasure{Cauchy,Liberation}::get_alignment)
+        return self.k * self.w * self.packetsize
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        alignment = self.get_alignment()
+        tail = stripe_width % alignment
+        padded = stripe_width + (alignment - tail if tail else 0)
+        return padded // self.k
+
+    # -- plane layout -------------------------------------------------------
+    def _planes(self, chunks: np.ndarray) -> np.ndarray:
+        """(n, csize) chunk rows -> (n*w, csize//w) packet planes."""
+        n, csize = chunks.shape
+        ps = self.packetsize
+        regions = csize // (self.w * ps)
+        # (n, regions, w, ps) -> (n, w, regions, ps) -> (n*w, regions*ps)
+        return (chunks.reshape(n, regions, self.w, ps)
+                .transpose(0, 2, 1, 3)
+                .reshape(n * self.w, regions * ps))
+
+    def _unplanes(self, planes: np.ndarray, n: int,
+                  csize: int) -> np.ndarray:
+        ps = self.packetsize
+        regions = csize // (self.w * ps)
+        return (planes.reshape(n, self.w, regions, ps)
+                .transpose(0, 2, 1, 3)
+                .reshape(n, csize))
+
+    # -- encode/decode ------------------------------------------------------
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        k, m = self.k, self.m
+        data = np.stack([chunks[self.chunk_index(i)] for i in range(k)])
+        csize = data.shape[1]
+        if csize % (self.w * self.packetsize):
+            raise ValueError(
+                f"chunk size {csize} not a multiple of w*packetsize="
+                f"{self.w * self.packetsize}")
+        planes = self._planes(data)
+        coding = xor_matmul(self.bitmatrix, planes)
+        out = self._unplanes(coding, m, csize)
+        for r in range(m):
+            chunks[self.chunk_index(k + r)][:] = out[r]
+
+    def _generator_rows(self, chunk: int) -> np.ndarray:
+        """The w generator rows (over the k*w data planes) of ``chunk``."""
+        kw = self.k * self.w
+        if chunk < self.k:
+            rows = np.zeros((self.w, kw), dtype=np.uint8)
+            for r in range(self.w):
+                rows[r, chunk * self.w + r] = 1
+            return rows
+        return self.bitmatrix[(chunk - self.k) * self.w:
+                              (chunk - self.k + 1) * self.w]
+
+    def decode_chunks(
+        self, want_to_read: set[int], chunks: Mapping[int, np.ndarray],
+        decoded: dict[int, np.ndarray],
+    ) -> None:
+        k, m, w = self.k, self.m, self.w
+        erasures = [i for i in range(k + m) if i not in chunks]
+        if not erasures:
+            return
+        if len(erasures) > m:
+            raise IOError(f"{len(erasures)} erasures exceed m={m}")
+        available = sorted(set(range(k + m)) - set(erasures))
+        sel = available[:k]
+        key = ",".join(map(str, sel))
+        entry = self._inv_cache.get(key)
+        if entry is None:
+            s = np.concatenate([self._generator_rows(c) for c in sel])
+            inv = gf2_invert(s)           # raises if not decodable
+            self._inv_cache[key] = inv
+            while len(self._inv_cache) > 128:
+                self._inv_cache.popitem(last=False)
+        else:
+            inv = entry
+            self._inv_cache.move_to_end(key)   # LRU, not FIFO
+        csize = len(next(iter(decoded.values())))
+        src = np.stack([decoded[c] for c in sel])
+        data_planes = xor_matmul(inv, self._planes(src))
+        data = self._unplanes(data_planes, k, csize)
+        for e in erasures:
+            if e < k:
+                decoded[e][:] = data[e]
+        coding_erased = [e for e in erasures if e >= k]
+        if coding_erased:
+            planes = self._planes(data)
+            for e in coding_erased:
+                rows = self.bitmatrix[(e - k) * w:(e - k + 1) * w]
+                decoded[e][:] = self._unplanes(
+                    xor_matmul(rows, planes), 1, csize)[0]

@@ -1,25 +1,39 @@
-"""jerasure-semantics Reed-Solomon plugin (w=8 techniques).
+"""jerasure-semantics plugin: RS word techniques + bitmatrix techniques.
 
-Mirrors the reference's jerasure plugin techniques that operate byte-wise in
-GF(2^8) (src/erasure-code/jerasure/ErasureCodeJerasure.cc):
+Mirrors the reference's jerasure plugin techniques
+(src/erasure-code/jerasure/ErasureCodeJerasure.cc):
 
   * reed_sol_van  -- systematized extended-Vandermonde matrix
     (reed_sol_vandermonde_coding_matrix, ErasureCodeJerasure.cc:203)
   * reed_sol_r6_op -- RAID6 rows [1,1,..], [1,2,4,..] with m forced to 2
+  * cauchy_orig   -- 1/(i ^ (m+j)) GF(2^w) Cauchy matrix expanded to a
+    GF(2) bitmatrix (ErasureCodeJerasure.h:174, cauchy.c)
+  * cauchy_good   -- same with the ones-minimizing matrix improvement
+    (ErasureCodeJerasure.h:183)
+  * liberation    -- minimal-density RAID-6 bitmatrix, w prime
+    (ErasureCodeJerasure.h:192, liberation.c)
+  * blaum_roth    -- RAID-6 over F2[x]/M_{w+1}(x), w+1 prime
+    (ErasureCodeJerasure.h:229)
 
-Bit-matrix techniques (cauchy_orig/cauchy_good/liberation/blaum_roth/
-liber8tion) pack w sub-packets per element and are scheduled for a later
-round.  Chunk sizing follows ErasureCodeJerasure::get_chunk_size
-(:80-104): stripe padded to a multiple of k*w*sizeof(int) then divided.
+Bitmatrix techniques process chunks as regions of w packets of
+``packetsize`` bytes; their whole data path is XOR (see
+ec/bitmatrix_codec.py).  Chunk sizing follows
+ErasureCodeJerasure::get_chunk_size (:80-104).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from ..bitmatrix_codec import BitMatrixCodec
 from ..rs_codec import RSMatrixCodec
 from ..registry import ErasureCodePlugin
 from ...gf import gen_jerasure_rs_vandermonde, gf_pow
+from ...gf.gf2w import (
+    blaum_roth_coding_bitmatrix, cauchy_improve_coding_matrix,
+    cauchy_original_coding_matrix, liberation_coding_bitmatrix,
+    matrix_to_bitmatrix,
+)
 
 LARGEST_VECTOR_WORDSIZE = 16
 
@@ -112,9 +126,88 @@ class ErasureCodeJerasureReedSolomonRAID6(ErasureCodeJerasure):
             [np.eye(k, dtype=np.uint8), coding], axis=0)
 
 
+DEFAULT_PACKETSIZE = "2048"
+
+
+class ErasureCodeJerasureBitMatrix(BitMatrixCodec):
+    """Shared profile handling for the bitmatrix techniques."""
+
+    technique = ""
+    DEFAULT_K = "2"
+    DEFAULT_M = "2"
+    DEFAULT_W = "8"
+
+    def parse_base(self, profile) -> None:
+        self.k = self.to_int("k", profile, self.DEFAULT_K)
+        self.m = self.to_int("m", profile, self.DEFAULT_M)
+        self.w = self.to_int("w", profile, self.DEFAULT_W)
+        self.packetsize = self.to_int("packetsize", profile,
+                                      DEFAULT_PACKETSIZE)
+        if self.packetsize <= 0:
+            raise ValueError(
+                f"packetsize={self.packetsize} must be positive")
+        if self.w <= 0:
+            raise ValueError(f"w={self.w} must be positive")
+        self.sanity_check_k_m(self.k, self.m)
+
+    def init(self, profile) -> None:
+        self.parse(profile)
+        self.parse_base(profile)
+        self.prepare()
+        super().init(profile)
+
+
+class ErasureCodeJerasureCauchyOrig(ErasureCodeJerasureBitMatrix):
+    technique = "cauchy_orig"
+    DEFAULT_K = "7"
+    DEFAULT_M = "3"
+
+    def _coding_matrix(self):
+        return cauchy_original_coding_matrix(self.k, self.m, self.w)
+
+    def prepare(self) -> None:
+        self.bitmatrix = matrix_to_bitmatrix(
+            self._coding_matrix(), self.k, self.m, self.w)
+
+
+class ErasureCodeJerasureCauchyGood(ErasureCodeJerasureCauchyOrig):
+    technique = "cauchy_good"
+
+    def _coding_matrix(self):
+        return cauchy_improve_coding_matrix(
+            cauchy_original_coding_matrix(self.k, self.m, self.w),
+            self.k, self.m, self.w)
+
+
+class ErasureCodeJerasureLiberation(ErasureCodeJerasureBitMatrix):
+    technique = "liberation"
+    DEFAULT_K = "2"
+    DEFAULT_M = "2"
+    DEFAULT_W = "7"
+
+    def parse_base(self, profile) -> None:
+        super().parse_base(profile)
+        self.m = 2                  # RAID-6 family (ErasureCodeJerasure.h)
+
+    def prepare(self) -> None:
+        self.bitmatrix = liberation_coding_bitmatrix(self.k, self.w)
+
+
+class ErasureCodeJerasureBlaumRoth(ErasureCodeJerasureLiberation):
+    technique = "blaum_roth"
+    DEFAULT_W = "6"
+
+    def prepare(self) -> None:
+        self.bitmatrix = blaum_roth_coding_bitmatrix(self.k, self.w)
+
+
 TECHNIQUES = {
     "reed_sol_van": ErasureCodeJerasureReedSolomonVandermonde,
     "reed_sol_r6_op": ErasureCodeJerasureReedSolomonRAID6,
+    "cauchy_orig": ErasureCodeJerasureCauchyOrig,
+    "cauchy_good": ErasureCodeJerasureCauchyGood,
+    "liberation": ErasureCodeJerasureLiberation,
+    "blaum_roth": ErasureCodeJerasureBlaumRoth,
 }
 
 

@@ -29,7 +29,8 @@ class FsError(Exception):
 class FsFile:
     """An open file handle."""
 
-    def __init__(self, fs: "CephFS", path: str, dentry: dict) -> None:
+    def __init__(self, fs: "CephFS", path: str, dentry: dict,
+                 append: bool = False) -> None:
         self.fs = fs
         self.path = path
         self.dentry = dentry
@@ -39,10 +40,14 @@ class FsFile:
             stripe_unit=lay["su"], stripe_count=lay["sc"],
             object_size=lay["os"]))
         self.size = dentry.get("size", 0)
+        self._append = append
         self._dirty = False
         self._closed = False
 
-    async def write(self, data: bytes, offset: int = 0) -> int:
+    async def write(self, data: bytes, offset: int | None = None) -> int:
+        # append mode: every write lands at EOF (O_APPEND); otherwise
+        # an omitted offset means 0
+        offset = self.size if self._append else (offset or 0)
         await self.striper.write(f"{self.ino:x}", data, offset)
         self.size = max(self.size, offset + len(data))
         self._dirty = True
@@ -194,8 +199,8 @@ class CephFS:
         create = "w" in flags or "a" in flags or "+" in flags
         out = await self._request({"op": "open", "path": path,
                                    "create": create, "mode": mode})
-        f = FsFile(self, path, out["dentry"])
-        if "w" in flags and "+" not in flags:
+        f = FsFile(self, path, out["dentry"], append="a" in flags)
+        if "w" in flags:        # 'w' and 'w+' both truncate (fopen(3))
             await f.truncate(0)
         return f
 

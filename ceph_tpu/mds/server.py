@@ -156,12 +156,27 @@ class MDS:
         except asyncio.CancelledError:
             pass
 
+    async def _renew_lock(self) -> None:
+        await self.meta.exec(MDSMAP_OID, "lock", "lock", json.dumps({
+            "name": LOCK_NAME, "type": "exclusive",
+            "cookie": self.name, "duration": LOCK_DURATION,
+            "flags": 1}).encode())
+
     async def _become_active(self) -> None:
         await self.journal.load()
+        n = 0
+        loop = asyncio.get_event_loop()
+        last_renew = loop.time()
         async for ev in self.journal.replay():   # crash-window replay
             await self._apply_event(ev, replay=True)
             if ev.get("reqid"):
                 self._remember(ev["reqid"], ev.get("reply", {}))
+            n += 1
+            # a long replay must not outlive the activation lease, or
+            # the standby wins the expired lock mid-replay (split-brain)
+            if n % 16 == 0 and loop.time() - last_renew > LOCK_RENEW:
+                await self._renew_lock()
+                last_renew = loop.time()
         await self.journal.trim()
         await self._load_inotable()
         # ensure the root dirfrag exists
@@ -438,6 +453,10 @@ class MDS:
                 raise FsOpError("ENOENT", path)
             dst_parent, dst_name, dst_dent = await self._resolve(
                 q["dst"], want_parent=True)
+            if dst_dent is not None and dst_dent["ino"] == dent["ino"]:
+                # rename onto itself: POSIX no-op (rename(2)); anything
+                # else would purge the file's own data as "replaced"
+                return {"dentry": dent}
             if dent["type"] == "dir":
                 # a directory must not move into its own subtree: the
                 # dirfrag would link to itself and the subtree would

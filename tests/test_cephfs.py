@@ -98,6 +98,19 @@ def test_rename_semantics():
             await fs.write_file("/b/old", b"stale")
             await fs.rename("/b/g", "/b/old")
             assert await fs.read_file("/b/old") == b"payload"
+            # rename onto itself is a POSIX no-op -- it must NOT purge
+            # the file's own data as a "replaced target"
+            await fs.rename("/b/old", "/b/old")
+            assert await fs.read_file("/b/old") == b"payload"
+            # open flags: 'w+' truncates, 'a' appends at EOF
+            f = await fs.open("/b/old", "a")
+            await f.write(b"-more")
+            await f.close()
+            assert await fs.read_file("/b/old") == b"payload-more"
+            f = await fs.open("/b/old", "w+")
+            await f.write(b"fresh")
+            await f.close()
+            assert await fs.read_file("/b/old") == b"fresh"
             # dir rename carries the subtree
             await fs.write_file("/a/deep", b"x")
             await fs.rename("/a", "/c")

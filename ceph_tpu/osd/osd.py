@@ -29,10 +29,25 @@ class OSD:
                  secret: bytes | None = None,
                  config: dict | None = None,
                  admin_socket_path: str | None = None) -> None:
-        self.uuid = uuid or uuid_mod.uuid4().hex
-        self.whoami = whoami if whoami is not None else -1
         self.host = host
         self.store = store or MemStore()
+        # identity lives in the store (OSD superblock analog,
+        # OSD::read_superblock): a daemon restarted on a durable store
+        # must reclaim its osd id (the mon resolves uuid->id), not
+        # register as a fresh OSD and orphan its own data
+        sb = self._read_superblock()
+        self.uuid = uuid or sb.get("uuid") or uuid_mod.uuid4().hex
+        if whoami is not None:
+            self.whoami = whoami
+        elif self.uuid == sb.get("uuid"):
+            # the stored id belongs to the stored uuid: reclaiming it
+            # under a DIFFERENT uuid would evict whatever daemon
+            # legitimately owns that id in the map
+            self.whoami = int(sb.get("whoami", -1))
+        else:
+            self.whoami = -1
+        if not sb:
+            self._write_superblock()
         self.config = {
             "osd_heartbeat_interval": 0.5,
             "osd_heartbeat_grace": 3.0,
@@ -83,6 +98,27 @@ class OSD:
         self._admin_socket_path = admin_socket_path
 
     # -- lifecycle ----------------------------------------------------------
+    # -- superblock (identity persisted with the data) ----------------------
+    _SB_COLL = "osd_superblock"
+    _SB_OID = "superblock"
+
+    def _read_superblock(self) -> dict:
+        if not self.store.collection_exists(self._SB_COLL):
+            return {}
+        omap = self.store.omap_get(self._SB_COLL, self._SB_OID)
+        return {k: v.decode() for k, v in omap.items()}
+
+    def _write_superblock(self) -> None:
+        from ..os.transaction import Transaction
+        txn = Transaction()
+        if not self.store.collection_exists(self._SB_COLL):
+            txn.create_collection(self._SB_COLL)
+            txn.touch(self._SB_COLL, self._SB_OID)
+        txn.omap_setkeys(self._SB_COLL, self._SB_OID, {
+            "uuid": self.uuid.encode(),
+            "whoami": str(self.whoami).encode()})
+        self.store.queue_transaction(txn)
+
     async def start(self, mon_addr: tuple[str, int],
                     host: str = "127.0.0.1", port: int = 0) -> int:
         self.mon_addr = tuple(mon_addr)
@@ -99,6 +135,7 @@ class OSD:
                          else None},
             reply_type="osd_boot_ack")
         self.whoami = ack["osd_id"]
+        self._write_superblock()
         self.monmap = [list(a) for a in ack.get("monmap", [])] or \
             [list(self.mon_addr)]
         self.msgr.name = f"osd.{self.whoami}"

@@ -82,28 +82,59 @@ class PG:
         self.backend = build_pg_backend(self)
 
     # -- persistence --------------------------------------------------------
+    # PG metadata persists in denc form (versioned binary envelopes,
+    # common/denc.py) as the reference encodes pg_info_t/pg_log_entry_t;
+    # a leading '{'/'[' marks a pre-denc JSON store and decodes through
+    # the dict path (cross-version compat in the ceph-object-corpus
+    # sense -- the corpus pins the byte format, tests/test_denc.py).
+    @staticmethod
+    def _is_json(raw: bytes) -> bool:
+        return raw[:1] in (b"{", b"[")
+
     def _load_meta(self) -> None:
+        from ..common.denc import Decoder
         omap = self.osd.store.omap_get(self.coll, META_OID)
-        if "info" in omap:
-            self.info = PGInfo.from_dict(json.loads(omap["info"]))
-        if "log" in omap:
-            self.log = PGLog.from_dict(json.loads(omap["log"]))
+
+        def load(key, denc_fn, json_fn):
+            raw = omap.get(key)
+            if raw is None:
+                return None
+            if self._is_json(raw):
+                return json_fn(json.loads(raw))
+            return denc_fn(raw)
+        got = load("info", lambda r: PGInfo.dedenc(Decoder(r)),
+                   PGInfo.from_dict)
+        if got is not None:
+            self.info = got
+        got = load("log", lambda r: PGLog.dedenc(Decoder(r)),
+                   PGLog.from_dict)
+        if got is not None:
+            self.log = got
             self._reindex_reqids()
-        if "missing" in omap:
-            self.missing = MissingSet.from_dict(json.loads(omap["missing"]))
-        if "past_intervals" in omap:
-            self.past_intervals = PastIntervals.from_dict(
-                json.loads(omap["past_intervals"]))
+        got = load("missing", lambda r: MissingSet.dedenc(Decoder(r)),
+                   MissingSet.from_dict)
+        if got is not None:
+            self.missing = got
+        got = load("past_intervals",
+                   lambda r: PastIntervals.dedenc(Decoder(r)),
+                   PastIntervals.from_dict)
+        if got is not None:
+            self.past_intervals = got
         if "trimmed_snaps" in omap:
             self.trimmed_snaps = set(json.loads(omap["trimmed_snaps"]))
 
     def _meta_kv(self) -> dict[str, bytes]:
+        from ..common.denc import Encoder
+
+        def denc_of(obj) -> bytes:
+            enc = Encoder()
+            obj.denc(enc)
+            return enc.bytes()
         return {
-            "info": json.dumps(self.info.to_dict()).encode(),
-            "log": json.dumps(self.log.to_dict()).encode(),
-            "missing": json.dumps(self.missing.to_dict()).encode(),
-            "past_intervals": json.dumps(
-                self.past_intervals.to_dict()).encode(),
+            "info": denc_of(self.info),
+            "log": denc_of(self.log),
+            "missing": denc_of(self.missing),
+            "past_intervals": denc_of(self.past_intervals),
             "trimmed_snaps": json.dumps(
                 sorted(self.trimmed_snaps)).encode(),
         }

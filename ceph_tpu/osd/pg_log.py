@@ -194,3 +194,19 @@ class PGLog:
         return cls(tail=EVersion.from_list(d["tail"]),
                    head=EVersion.from_list(d["head"]),
                    entries=[LogEntry.from_dict(e) for e in d["entries"]])
+
+    def denc(self, enc) -> None:
+        enc.start(1, 1)
+        self.tail.denc(enc)
+        self.head.denc(enc)
+        enc.list(self.entries, lambda e, le: le.denc(e))
+        enc.finish()
+
+    @classmethod
+    def dedenc(cls, dec) -> "PGLog":
+        dec.start(1)
+        out = cls(tail=EVersion.dedenc(dec), head=EVersion.dedenc(dec),
+                  entries=dec.list(lambda d: LogEntry.dedenc(d)))
+        dec.finish()
+        return out
+

@@ -4,13 +4,23 @@ Modeled on src/osd/osd_types.h: eversion_t (epoch, version) total order,
 pg_log_entry_t (:4325) with op/soid/version/prior_version, pg_info_t
 (last_update/last_complete/log_tail + history), and pg_missing_t
 (need/have per object, drives log-based recovery).
+
+Each type carries BOTH a dict form (wire JSON) and a denc form
+(versioned binary, common/denc.py) -- the persistent PG metadata uses
+denc the way the reference encodes pg_info_t/pg_log_entry_t with
+ENCODE_START envelopes; byte-stability is pinned by the committed
+corpus (tests/fixtures/corpus, tools/dencoder.py).
 """
 
 from __future__ import annotations
 
+import json
+
 from dataclasses import dataclass, field
 from functools import total_ordering
 from typing import Any
+
+from ..common.denc import Decoder, Encoder
 
 
 @total_ordering
@@ -33,6 +43,14 @@ class EVersion:
     @classmethod
     def from_list(cls, v) -> "EVersion":
         return cls(int(v[0]), int(v[1]))
+
+    def denc(self, enc: Encoder) -> None:
+        # eversion_t is a fixed struct, no envelope (osd_types.h)
+        enc.u32(self.epoch).u64(self.version)
+
+    @classmethod
+    def dedenc(cls, dec: Decoder) -> "EVersion":
+        return cls(dec.u32(), dec.u64())
 
 
 ZERO = EVersion()
@@ -77,6 +95,33 @@ class LogEntry:
                    prior_version=EVersion.from_list(d["pv"]),
                    mutations=list(d.get("m", [])),
                    reqid=(rq[0], rq[1]) if rq else None)
+
+    def denc(self, enc: Encoder) -> None:
+        enc.start(1, 1)
+        enc.string(self.op).string(self.oid)
+        self.version.denc(enc)
+        self.prior_version.denc(enc)
+        # mutation payloads are free-form op descriptions; they ride as
+        # an opaque blob the way pg_log_entry_t embeds op bufferlists
+        enc.blob(json.dumps(self.mutations,
+                            separators=(",", ":")).encode())
+        enc.optional(self.reqid, lambda e, rq: (e.string(rq[0]),
+                                                e.u64(rq[1])))
+        enc.finish()
+
+    @classmethod
+    def dedenc(cls, dec: Decoder) -> "LogEntry":
+        dec.start(1)
+        op = dec.string()
+        oid = dec.string()
+        version = EVersion.dedenc(dec)
+        prior = EVersion.dedenc(dec)
+        mutations = json.loads(dec.blob() or b"[]")
+        reqid = dec.optional(lambda d: (d.string(), d.u64()))
+        dec.finish()
+        return cls(op=op, oid=oid, version=version,
+                   prior_version=prior, mutations=mutations,
+                   reqid=reqid)
 
 
 @dataclass
@@ -125,6 +170,32 @@ class PGInfo:
                    backfill_complete=d.get("backfill_complete", True),
                    last_backfill=d.get("last_backfill", ""))
 
+    def denc(self, enc: Encoder) -> None:
+        enc.start(1, 1)
+        enc.string(self.pgid)
+        self.last_update.denc(enc)
+        self.last_complete.denc(enc)
+        self.log_tail.denc(enc)
+        enc.u32(self.last_epoch_started)
+        enc.u32(self.same_interval_since)
+        enc.boolean(self.backfill_complete)
+        enc.string(self.last_backfill)
+        enc.finish()
+
+    @classmethod
+    def dedenc(cls, dec: Decoder) -> "PGInfo":
+        dec.start(1)
+        out = cls(pgid=dec.string(),
+                  last_update=EVersion.dedenc(dec),
+                  last_complete=EVersion.dedenc(dec),
+                  log_tail=EVersion.dedenc(dec),
+                  last_epoch_started=dec.u32(),
+                  same_interval_since=dec.u32(),
+                  backfill_complete=dec.boolean(),
+                  last_backfill=dec.string())
+        dec.finish()
+        return out
+
 
 class MissingSet:
     """Objects a replica lacks: oid -> (need, have) (pg_missing_t)."""
@@ -168,6 +239,22 @@ class MissingSet:
                              EVersion.from_list(have))
         return ms
 
+    def denc(self, enc: Encoder) -> None:
+        enc.start(1, 1)
+        enc.map(self.items, lambda e, k: e.string(k),
+                lambda e, v: (v[0].denc(e), v[1].denc(e)))
+        enc.finish()
+
+    @classmethod
+    def dedenc(cls, dec: Decoder) -> "MissingSet":
+        dec.start(1)
+        ms = cls()
+        ms.items = dec.map(
+            lambda d: d.string(),
+            lambda d: (EVersion.dedenc(d), EVersion.dedenc(d)))
+        dec.finish()
+        return ms
+
 
 class PastIntervals:
     """Acting-set history across map epochs (compact form).
@@ -202,4 +289,21 @@ class PastIntervals:
     def from_dict(cls, d: dict) -> "PastIntervals":
         pi = cls()
         pi.intervals = list(d.get("intervals", []))
+        return pi
+
+    def denc(self, enc: Encoder) -> None:
+        enc.start(1, 1)
+        enc.list(self.intervals, lambda e, iv: (
+            e.u32(iv["first"]), e.u32(iv["last"]),
+            e.list(iv["acting"], lambda e2, o: e2.i64(o))))
+        enc.finish()
+
+    @classmethod
+    def dedenc(cls, dec: Decoder) -> "PastIntervals":
+        dec.start(1)
+        pi = cls()
+        pi.intervals = dec.list(lambda d: {
+            "first": d.u32(), "last": d.u32(),
+            "acting": d.list(lambda d2: d2.i64())})
+        dec.finish()
         return pi

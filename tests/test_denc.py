@@ -1,0 +1,193 @@
+"""denc versioned encoding: envelopes, compat rules, corpus stability,
+and the PG-meta JSON->denc upgrade path (src/include/denc.h,
+ceph-object-corpus discipline)."""
+
+import json
+import os
+
+import pytest
+
+from ceph_tpu.common.denc import (
+    Decoder, DencError, Encoder, IncompatibleVersion,
+)
+from ceph_tpu.osd.pg_log import PGLog
+from ceph_tpu.osd.types import EVersion, LogEntry, MissingSet, PGInfo
+from ceph_tpu.tools import dencoder
+
+CORPUS = os.path.join(os.path.dirname(__file__), "fixtures", "corpus")
+
+
+def test_primitives_roundtrip():
+    enc = Encoder()
+    enc.u8(7).u16(65535).u32(1 << 31).u64(1 << 60).i64(-42)
+    enc.f64(3.5).boolean(True).string("héllo").blob(b"\x00\xff")
+    enc.list([1, 2, 3], lambda e, v: e.u32(v))
+    enc.map({"b": 2, "a": 1}, lambda e, k: e.string(k),
+            lambda e, v: e.u64(v))
+    enc.optional(None, lambda e, v: e.u8(v))
+    enc.optional(9, lambda e, v: e.u8(v))
+    dec = Decoder(enc.bytes())
+    assert dec.u8() == 7
+    assert dec.u16() == 65535
+    assert dec.u32() == 1 << 31
+    assert dec.u64() == 1 << 60
+    assert dec.i64() == -42
+    assert dec.f64() == 3.5
+    assert dec.boolean() is True
+    assert dec.string() == "héllo"
+    assert dec.blob() == b"\x00\xff"
+    assert dec.list(lambda d: d.u32()) == [1, 2, 3]
+    assert dec.map(lambda d: d.string(),
+                   lambda d: d.u64()) == {"a": 1, "b": 2}
+    assert dec.optional(lambda d: d.u8()) is None
+    assert dec.optional(lambda d: d.u8()) == 9
+    assert dec.remaining() == 0
+
+
+def test_forward_compat_skips_new_fields():
+    """Old code must decode a NEWER encoder's output: the envelope
+    length lets DECODE_FINISH skip fields it doesn't know."""
+    enc = Encoder()
+    enc.start(3, 1)            # v3 encoding, readable since v1
+    enc.u32(1234)              # the v1 field
+    enc.string("a-v3-only-field")
+    enc.u64(999)               # another v3 field
+    enc.finish()
+    enc.u32(0xCAFE)            # data AFTER the envelope
+    dec = Decoder(enc.bytes())
+    v = dec.start(1)           # v1-era decoder
+    assert v == 3
+    assert dec.u32() == 1234   # reads what it knows
+    dec.finish()               # skips the rest of the envelope
+    assert dec.u32() == 0xCAFE
+
+
+def test_backward_incompat_detected():
+    enc = Encoder()
+    enc.start(5, 4)            # readable only by v4+ decoders
+    enc.u32(1)
+    enc.finish()
+    dec = Decoder(enc.bytes())
+    with pytest.raises(IncompatibleVersion):
+        dec.start(2)
+
+
+def test_bounds_checked():
+    enc = Encoder()
+    enc.start(1, 1)
+    enc.u32(1)
+    enc.finish()
+    dec = Decoder(enc.bytes())
+    dec.start(1)
+    dec.u32()
+    with pytest.raises(DencError):
+        dec.u64()              # read past the envelope end
+
+
+def test_lying_envelope_length_rejected():
+    """An envelope claiming more bytes than its parent holds must fail
+    loudly, not let reads walk into sibling data."""
+    enc = Encoder()
+    enc.start(1, 1)
+    enc.u32(1)
+    enc.finish()
+    buf = bytearray(enc.bytes())
+    buf[2:6] = (1000).to_bytes(4, "little")    # lie about the length
+    dec = Decoder(bytes(buf))
+    with pytest.raises(DencError):
+        dec.start(1)
+    # truncated buffer: DencError, not raw struct.error
+    dec2 = Decoder(enc.bytes()[:7])
+    with pytest.raises(DencError):
+        dec2.start(1)
+
+
+def test_type_roundtrips():
+    for name, t in dencoder.TYPES.items():
+        for obj in t["samples"]():
+            blob = t["enc"](obj)
+            back = t["dec"](blob)
+            assert t["dump"](back) == t["dump"](obj), name
+            assert t["enc"](back) == blob, f"{name}: non-deterministic"
+
+
+def test_committed_corpus_stable():
+    """The committed corpus blobs must decode and re-encode
+    byte-identically forever (ceph_object_corpus non-regression)."""
+    assert dencoder.corpus_check(CORPUS) == 0
+
+
+def test_osd_superblock_identity():
+    """An OSD restarted on its own store reclaims uuid+id; a DIFFERENT
+    uuid on the same store must NOT inherit the stored id (it would
+    evict the id's legitimate owner from the map)."""
+    from ceph_tpu.os.store import MemStore
+    from ceph_tpu.osd import OSD
+
+    store = MemStore()
+    a = OSD(store=store)
+    a.whoami = 7
+    a._write_superblock()
+    again = OSD(store=store)            # same store, no explicit uuid
+    assert again.uuid == a.uuid
+    assert again.whoami == 7
+    imposter = OSD(store=store, uuid="somebody-else")
+    assert imposter.whoami == -1
+
+
+def test_pg_meta_json_upgrade(tmp_path):
+    """A PG whose metadata was persisted by the JSON-era code must load
+    through the compat path and persist denc thereafter."""
+    from ceph_tpu.os.store import MemStore
+    from ceph_tpu.os.transaction import Transaction
+    from ceph_tpu.osd.backend import META_OID
+
+    store = MemStore()
+    txn = Transaction()
+    txn.create_collection("pg_1.0")
+    txn.touch("pg_1.0", META_OID)
+    info = PGInfo(pgid="1.0", last_update=EVersion(3, 9),
+                  last_complete=EVersion(3, 9))
+    log = PGLog()
+    e = LogEntry(op="modify", oid="o", version=EVersion(3, 9),
+                 reqid=("c:1", 4))
+    log.entries.append(e)
+    log.head = e.version
+    ms = MissingSet()
+    ms.add("x", need=EVersion(2, 2), have=EVersion(0, 0))
+    txn.omap_setkeys("pg_1.0", META_OID, {
+        "info": json.dumps(info.to_dict()).encode(),
+        "log": json.dumps(log.to_dict()).encode(),
+        "missing": json.dumps(ms.to_dict()).encode(),
+    })
+    store.queue_transaction(txn)
+
+    class FakeOSD:
+        pass
+    osd = FakeOSD()
+    osd.store = store
+    osd.whoami = 0
+
+    class FakePool:
+        pool_id = 1
+        pool_type = "replicated"
+        size = 1
+        min_size = 1
+
+        def can_shift_osds(self):
+            return True
+
+        def is_erasure(self):
+            return False
+    from ceph_tpu.osd.pg import PG
+    pg = PG(osd, "1.0", FakePool(), None)
+    assert pg.info.last_update == EVersion(3, 9)
+    assert pg.log.entries[0].reqid == ("c:1", 4)
+    assert pg.missing.is_missing("x")
+    # persisting now writes denc; reloading still agrees
+    pg.persist_meta()
+    raw = store.omap_get("pg_1.0", META_OID)["info"]
+    assert raw[:1] not in (b"{", b"[")      # binary now
+    pg2 = PG(osd, "1.0", FakePool(), None)
+    assert pg2.info.last_update == EVersion(3, 9)
+    assert pg2.log.entries[0].reqid == ("c:1", 4)

@@ -32,6 +32,13 @@ class IncompatibleVersion(DencError):
     pass
 
 
+def denc_bytes(obj) -> bytes:
+    """Encode one denc-capable object (has .denc(enc)) to bytes."""
+    enc = Encoder()
+    obj.denc(enc)
+    return enc.bytes()
+
+
 class Encoder:
     def __init__(self) -> None:
         self.buf = bytearray()

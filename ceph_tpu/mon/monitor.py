@@ -117,6 +117,10 @@ class Monitor:
         self.perf_paxos = self.perf.create("paxos")
         self.admin_socket: AdminSocket | None = None
         self._admin_socket_path = admin_socket_path
+        # the other PaxosServices (auth/config/log/health) ride the
+        # same paxos commits via Incremental.service_kv
+        from .services import MonServices
+        self.services = MonServices(self)
         self.msgr.add_dispatcher(self._dispatch)
         self._replay()
 
@@ -128,6 +132,7 @@ class Monitor:
             if blob:
                 inc = Incremental.from_dict(json.loads(blob))
                 self.osdmap.apply_incremental(inc)
+                self.services.apply(inc.service_kv)
                 self.incrementals[inc.epoch] = inc
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
@@ -440,6 +445,14 @@ class Monitor:
         self.store.commit(version, blob)
         inc = Incremental.from_dict(json.loads(blob))
         self.osdmap.apply_incremental(inc)
+        self.services.apply(inc.service_kv)
+        if "config" in inc.service_kv:
+            # EVERY mon pushes config to ITS subscribers (a daemon
+            # subscribed to a peon must see changes the leader commits)
+            t = asyncio.ensure_future(self.push_config())
+            self._bg_tasks = getattr(self, "_bg_tasks", set())
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
         self.incrementals[inc.epoch] = inc
         # EVERY mon pushes deltas to its own subscribers (peons serve
         # map subscriptions too; the reference mons all publish)
@@ -625,6 +638,11 @@ class Monitor:
         if len(self.failure_reports[target]) >= need:
             inc = Incremental(epoch=0)
             inc.new_down.append(target)
+            # the mark-down rides with its cluster-log entry in ONE
+            # commit (LogMonitor entries share the map's paxos)
+            inc.service_kv = {"log": self.services.log_entry(
+                "WRN", f"osd.{target} marked down after "
+                       f"{len(self.failure_reports[target])} reports")}
             self.failure_reports.pop(target, None)
             self._down_since[target] = time.monotonic()
             await self.propose(inc)
@@ -658,6 +676,7 @@ class Monitor:
         changed = getattr(self, "mgr_addr", None) != addr
         self.mgr_addr = addr
         self.mgr_name = msg.data.get("name", "")
+        self.mgr_last_beacon = time.monotonic()
         if changed:
             payload = {"name": self.mgr_name, "addr": list(addr)}
             for name, sub in list(self.subscribers.items()):
@@ -670,6 +689,9 @@ class Monitor:
         self.subscribers[msg.from_name] = conn
         await conn.send(Message("osdmap_full",
                                 {"map": self.osdmap.to_dict()}))
+        cfg = self.services.config_for(msg.from_name)
+        if cfg:                  # central config lands at subscription
+            await conn.send(Message("config_update", {"config": cfg}))
         if getattr(self, "mgr_addr", None):   # late joiners learn the mgr
             await conn.send(Message("mgr_map",
                                     {"name": self.mgr_name,
@@ -732,7 +754,29 @@ class Monitor:
             fut.set_result({k: v for k, v in msg.data.items()
                             if k != "tid"})
 
+    async def propose_service_kv(self, service: str, kv: dict) -> None:
+        """Commit a non-osdmap service mutation through paxos."""
+        inc = Incremental(epoch=0)
+        inc.service_kv = {service: kv}
+        await self.propose(inc)
+
+    async def push_config(self) -> None:
+        """Push effective config to subscribed daemons (the mon sends
+        MConfig on changes; daemons apply via ConfigProxy observers)."""
+        for name, conn in list(self.subscribers.items()):
+            try:
+                await conn.send(Message(
+                    "config_update",
+                    {"config": self.services.config_for(name)}))
+            except (ConnectionError, OSError):
+                pass
+
     async def handle_command(self, cmd: str, args: dict):
+        from .services import UnknownCommand
+        try:
+            return await self.services.handle_command(cmd, args)
+        except UnknownCommand:
+            pass                 # not a service command; fall through
         if cmd == "osd pool create":
             return await self._cmd_pool_create(args)
         if cmd == "osd pool rm":
@@ -833,12 +877,15 @@ class Monitor:
         if cmd == "status":
             n_up = sum(1 for o in self.osdmap.osds.values() if o.up)
             n_in = sum(1 for o in self.osdmap.osds.values() if o.in_cluster)
+            health = self.services.health()
             return {"epoch": self.osdmap.epoch,
                     "num_osds": len(self.osdmap.osds),
                     "num_up": n_up, "num_in": n_in,
                     "pools": len(self.osdmap.pools),
-                    "health": "HEALTH_OK" if n_up == len(self.osdmap.osds)
-                              else "HEALTH_WARN"}
+                    "quorum": sorted(self.quorum),
+                    "health": health["status"],
+                    "checks": {k: v["summary"]
+                               for k, v in health["checks"].items()}}
         raise ValueError(f"unknown command: {cmd}")
 
     async def _cmd_pool_create(self, args: dict):

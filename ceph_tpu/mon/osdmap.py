@@ -120,6 +120,11 @@ class Incremental:
     new_hosts: dict[int, str] = field(default_factory=dict)
     # pool_id -> {"snap_seq": int, "removed": [snapids]}
     new_pool_snaps: dict[int, dict] = field(default_factory=dict)
+    # other PaxosService payloads riding the SAME paxos commit (the
+    # reference multiplexes every service over one paxos instance):
+    # service -> {key: value-or-None(delete)}; applied by the Monitor's
+    # service layer, opaque to the osdmap itself
+    service_kv: dict[str, dict] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         d = asdict(self)
@@ -156,6 +161,7 @@ class Incremental:
                        for k, v in d.get("new_uuids", {}).items()},
             new_hosts={int(k): v
                        for k, v in d.get("new_hosts", {}).items()},
+            service_kv=dict(d.get("service_kv", {})),
             new_pool_snaps={int(k): v for k, v in
                             d.get("new_pool_snaps", {}).items()},
         )

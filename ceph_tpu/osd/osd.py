@@ -54,6 +54,9 @@ class OSD:
             "osd_max_backfills": 2,
             **(config or {}),
         }
+        # pre-override snapshot: central-config removals revert to this
+        self._base_config = dict(self.config)
+        self._pushed_config: set[str] = set()
         # typed registry over the same values: admin-socket `config set`
         # flows through the schema validation and back into the dict the
         # hot paths read (ConfigProxy observer pattern)
@@ -547,6 +550,45 @@ class OSD:
         handler = getattr(self, f"_h_{msg.type}", None)
         if handler is not None:
             await handler(conn, msg)
+
+    async def _h_config_update(self, conn, msg) -> None:
+        """Central config push (ConfigMonitor -> MConfig): values flow
+        through the ConfigProxy so observers fire on change.  The
+        message carries the FULL effective config: keys previously
+        pushed but now absent revert to their local values (config rm
+        must actually undo the override)."""
+        cfg = msg.data.get("config", {})
+        pushed = getattr(self, "_pushed_config", set())
+        for name in pushed - set(cfg):
+            if name in self._base_config:
+                self.config[name] = self._base_config[name]
+                try:
+                    self.conf.set(name, self._base_config[name])
+                except (KeyError, ValueError):
+                    pass
+            else:
+                self.config.pop(name, None)
+        applied = set()
+        for name, value in cfg.items():
+            try:
+                self.conf.set(name, value)
+                applied.add(name)
+            except ValueError:
+                # KNOWN option, invalid value: reject -- a raw string
+                # in the hot-path dict would blow up comparisons later
+                continue
+            except KeyError:
+                # unschema'd option: best-effort numeric cast so hot
+                # paths comparing against numbers keep working
+                for cast in (int, float):
+                    try:
+                        value = cast(value)
+                        break
+                    except (TypeError, ValueError):
+                        continue
+                self.config[name] = value
+                applied.add(name)
+        self._pushed_config = applied
 
     async def _h_osdmap_inc(self, conn, msg) -> None:
         self._apply_incremental(msg.data["inc"])

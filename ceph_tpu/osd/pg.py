@@ -124,17 +124,12 @@ class PG:
             self.trimmed_snaps = set(json.loads(omap["trimmed_snaps"]))
 
     def _meta_kv(self) -> dict[str, bytes]:
-        from ..common.denc import Encoder
-
-        def denc_of(obj) -> bytes:
-            enc = Encoder()
-            obj.denc(enc)
-            return enc.bytes()
+        from ..common.denc import denc_bytes
         return {
-            "info": denc_of(self.info),
-            "log": denc_of(self.log),
-            "missing": denc_of(self.missing),
-            "past_intervals": denc_of(self.past_intervals),
+            "info": denc_bytes(self.info),
+            "log": denc_bytes(self.log),
+            "missing": denc_bytes(self.missing),
+            "past_intervals": denc_bytes(self.past_intervals),
             "trimmed_snaps": json.dumps(
                 sorted(self.trimmed_snaps)).encode(),
         }

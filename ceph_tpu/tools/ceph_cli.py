@@ -88,6 +88,42 @@ def _parse_command(words: list[str]) -> tuple[str, dict]:
             profile[k] = v
         return ("osd erasure-code-profile set",
                 {"name": words[3], "profile": profile})
+    if words[0] == "health":
+        return "health", ({"detail": True} if "detail" in words[1:]
+                          else {})
+    if words[:2] == ["config", "set"]:
+        _want(words, 5, "config set <who> <name> <value>")
+        return "config set", {"who": words[2], "name": words[3],
+                              "value": words[4]}
+    if words[:2] == ["config", "get"]:
+        _want(words, 3, "config get <who>")
+        return "config get", {"who": words[2]}
+    if words[:2] == ["config", "rm"]:
+        _want(words, 4, "config rm <who> <name>")
+        return "config rm", {"who": words[2], "name": words[3]}
+    if words[:2] == ["config", "dump"]:
+        return "config dump", {}
+    if words[:2] == ["auth", "get-or-create"]:
+        _want(words, 3, "auth get-or-create <entity> [type=cap ...]")
+        caps = {}
+        for kv in words[3:]:
+            k, _, v = kv.partition("=")
+            caps[k] = v
+        return "auth get-or-create", {"entity": words[2], "caps": caps}
+    if words[:2] == ["auth", "get"]:
+        _want(words, 3, "auth get <entity>")
+        return "auth get", {"entity": words[2]}
+    if words[:2] == ["auth", "ls"]:
+        return "auth ls", {}
+    if words[:2] == ["auth", "rm"]:
+        _want(words, 3, "auth rm <entity>")
+        return "auth rm", {"entity": words[2]}
+    if words[:2] == ["log", "last"]:
+        return "log last", ({"n": int(words[2])}
+                            if len(words) > 2 else {})
+    if words[0] == "log":
+        _want(words, 2, "log <message...>")
+        return "log", {"message": " ".join(words[1:])}
     raise ValueError(f"unknown command: {joined}")
 
 

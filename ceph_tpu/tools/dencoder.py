@@ -17,7 +17,7 @@ import json
 import sys
 from pathlib import Path
 
-from ..common.denc import Decoder, Encoder
+from ..common.denc import Decoder, denc_bytes
 from ..osd.pg_log import PGLog
 from ..osd.types import (
     EVersion, LogEntry, MissingSet, PastIntervals, PGInfo, ZERO,
@@ -66,44 +66,24 @@ def _samples_pglog():
     yield log
 
 
+def _entry(cls, samples):
+    """All registered types share denc/to_dict conventions; only the
+    class and its sample generator differ."""
+    return {
+        "samples": samples,
+        "enc": denc_bytes,
+        "dec": lambda b, c=cls: c.dedenc(Decoder(b)),
+        "dump": lambda o: o.to_dict(),
+    }
+
+
 TYPES = {
-    "PGInfo": {
-        "samples": _samples_pginfo,
-        "enc": lambda o: _enc(o),
-        "dec": lambda b: PGInfo.dedenc(Decoder(b)),
-        "dump": lambda o: o.to_dict(),
-    },
-    "LogEntry": {
-        "samples": _samples_logentry,
-        "enc": lambda o: _enc(o),
-        "dec": lambda b: LogEntry.dedenc(Decoder(b)),
-        "dump": lambda o: o.to_dict(),
-    },
-    "MissingSet": {
-        "samples": _samples_missing,
-        "enc": lambda o: _enc(o),
-        "dec": lambda b: MissingSet.dedenc(Decoder(b)),
-        "dump": lambda o: o.to_dict(),
-    },
-    "PastIntervals": {
-        "samples": _samples_pastintervals,
-        "enc": lambda o: _enc(o),
-        "dec": lambda b: PastIntervals.dedenc(Decoder(b)),
-        "dump": lambda o: o.to_dict(),
-    },
-    "PGLog": {
-        "samples": _samples_pglog,
-        "enc": lambda o: _enc(o),
-        "dec": lambda b: PGLog.dedenc(Decoder(b)),
-        "dump": lambda o: o.to_dict(),
-    },
+    "PGInfo": _entry(PGInfo, _samples_pginfo),
+    "LogEntry": _entry(LogEntry, _samples_logentry),
+    "MissingSet": _entry(MissingSet, _samples_missing),
+    "PastIntervals": _entry(PastIntervals, _samples_pastintervals),
+    "PGLog": _entry(PGLog, _samples_pglog),
 }
-
-
-def _enc(obj) -> bytes:
-    enc = Encoder()
-    obj.denc(enc)
-    return enc.bytes()
 
 
 def corpus_check(root: str) -> int:

@@ -1,0 +1,140 @@
+"""Mon PaxosService breadth: auth, central config, cluster log, health
+(src/mon/{AuthMonitor,ConfigMonitor,LogMonitor}.cc, health_check.h)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client import Rados, RadosError
+from ceph_tpu.mon import Monitor
+
+from test_client import make_cluster, teardown, run
+
+
+async def wait_for(cond, timeout=20.0, msg="condition"):
+    for _ in range(int(timeout / 0.2)):
+        if cond():
+            return
+        await asyncio.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def test_config_auth_log_health():
+    async def main():
+        mon, osds = await make_cluster(3)
+        rados = await Rados(mon.msgr.addr).connect()
+        try:
+            # -- central config pushed to a live daemon ------------------
+            assert osds[0].config["osd_max_backfills"] == 2
+            await rados.mon_command(
+                "config set", {"who": "osd",
+                               "name": "osd_max_backfills",
+                               "value": "5"})
+            await wait_for(
+                lambda: osds[0].config["osd_max_backfills"] == 5,
+                msg="config push to osd.0")
+            assert osds[2].config["osd_max_backfills"] == 5
+            got = await rados.mon_command("config get", {"who": "osd.1"})
+            assert got["osd_max_backfills"] == "5"
+            dump = await rados.mon_command("config dump", {})
+            assert dump["osd/osd_max_backfills"] == "5"
+            # id-section overrides type-section
+            await rados.mon_command(
+                "config set", {"who": "osd.1",
+                               "name": "osd_max_backfills",
+                               "value": "7"})
+            got = await rados.mon_command("config get", {"who": "osd.1"})
+            assert got["osd_max_backfills"] == "7"
+            # rm REVERTS the daemons to their pre-override values
+            await rados.mon_command(
+                "config rm", {"who": "osd", "name": "osd_max_backfills"})
+            await rados.mon_command(
+                "config rm", {"who": "osd.1",
+                              "name": "osd_max_backfills"})
+            await wait_for(
+                lambda: osds[0].config["osd_max_backfills"] == 2,
+                msg="config revert on rm")
+            # a bogus value for a KNOWN option is rejected, not stored
+            await rados.mon_command(
+                "config set", {"who": "osd",
+                               "name": "osd_heartbeat_grace",
+                               "value": "not-a-number"})
+            await asyncio.sleep(0.5)
+            assert isinstance(osds[0].config["osd_heartbeat_grace"],
+                              float)
+            await rados.mon_command(
+                "config rm", {"who": "osd",
+                              "name": "osd_heartbeat_grace"})
+
+            # -- auth provisioning ---------------------------------------
+            a = await rados.mon_command(
+                "auth get-or-create",
+                {"entity": "client.rgw",
+                 "caps": {"mon": "allow r", "osd": "allow rwx"}})
+            assert len(a["key"]) == 32
+            again = await rados.mon_command("auth get-or-create",
+                                            {"entity": "client.rgw"})
+            assert again["key"] == a["key"]     # idempotent
+            ls = await rados.mon_command("auth ls", {})
+            assert "client.rgw" in ls
+            got = await rados.mon_command("auth get",
+                                          {"entity": "client.rgw"})
+            assert got["caps"]["osd"] == "allow rwx"
+            await rados.mon_command("auth rm", {"entity": "client.rgw"})
+            with pytest.raises(RadosError):
+                await rados.mon_command("auth get",
+                                        {"entity": "client.rgw"})
+
+            # -- cluster log ---------------------------------------------
+            await rados.mon_command("log", {"message": "hello cluster"})
+            last = await rados.mon_command("log last", {"n": 5})
+            assert any(e["message"] == "hello cluster" for e in last)
+
+            # -- health --------------------------------------------------
+            h = await rados.mon_command("health", {})
+            assert h["status"] == "HEALTH_OK"
+            await osds[1].stop()
+            await wait_for(
+                lambda: not mon.osdmap.is_up(osds[1].whoami),
+                msg="mark down")
+            h = await rados.mon_command("health", {"detail": True})
+            assert h["status"] in ("HEALTH_WARN", "HEALTH_ERR")
+            assert "OSD_DOWN" in h["checks"]
+            # the mark-down also landed in the cluster log
+            last = await rados.mon_command("log last", {"n": 10})
+            assert any("marked down" in e["message"] for e in last)
+            st = await rados.mon_command("status", {})
+            assert st["health"] != "HEALTH_OK"
+            assert "OSD_DOWN" in st["checks"]
+        finally:
+            await teardown(mon, osds, rados)
+    run(main())
+
+
+def test_service_state_replicated_and_replayed():
+    """Service state must survive a mon restart (paxos log replay) --
+    the ConfigMonitor/AuthMonitor state is IN the commit log."""
+    async def main(db):
+        mon = Monitor(rank=0, store_path=db)
+        addr = await mon.start(port=0)
+        mon.peer_addrs = [addr]
+        rados = await Rados(addr).connect()
+        await rados.mon_command(
+            "config set", {"who": "global", "name": "mon_lease",
+                           "value": "9"})
+        await rados.mon_command("auth get-or-create",
+                                {"entity": "client.x"})
+        await rados.mon_command("log", {"message": "before restart"})
+        await rados.shutdown()
+        await mon.stop()
+        # fresh process: same store
+        mon2 = Monitor(rank=0, store_path=db)
+        assert mon2.services.config_db["global/mon_lease"] == "9"
+        assert "client.x" in mon2.services.auth_db
+        assert any(e["message"] == "before restart"
+                   for e in mon2.services.cluster_log)
+
+    import tempfile
+    import os
+    with tempfile.TemporaryDirectory() as d:
+        run(main(os.path.join(d, "mon.db")))

@@ -26,8 +26,9 @@ class ObjecterError(Exception):
 
 class Objecter:
     def __init__(self, name: str = "client.objecter",
-                 secret: bytes | None = None) -> None:
-        self.msgr = Messenger(name, secret=secret)
+                 secret: bytes | None = None,
+                 msgr_opts: dict | None = None) -> None:
+        self.msgr = Messenger(name, secret=secret, **(msgr_opts or {}))
         self.osdmap = OSDMap()
         self.mon_addr: tuple[str, int] | None = None
         self._tid = itertools.count(1)

@@ -32,7 +32,8 @@ class Rados:
 
     def __init__(self, mon_addr: tuple[str, int],
                  name: str | None = None,
-                 secret: bytes | None = None) -> None:
+                 secret: bytes | None = None,
+                 msgr_opts: dict | None = None) -> None:
         self.mon_addr = tuple(mon_addr)
         if name is None:
             # entity names must be unique per client instance: two
@@ -41,7 +42,8 @@ class Rados:
             # from the mon's auth handshake)
             import os
             name = f"client.{os.urandom(4).hex()}"
-        self.objecter = Objecter(name=name, secret=secret)
+        self.objecter = Objecter(name=name, secret=secret,
+                                 msgr_opts=msgr_opts)
         self.connected = False
 
     async def connect(self) -> "Rados":

@@ -1,0 +1,111 @@
+"""Compressor plugins (src/compressor analog).
+
+Same registry pattern as the erasure-code plugins (dlopen == module
+import): ``Compressor.create(name)`` returns a codec with
+compress/decompress, used standalone, by the messenger's on-wire
+compression (msg/messenger.py), and available to stores.  Backends
+map to what the runtime ships: zlib/zstd/lzma/bz2 (snappy and lz4
+have no bundled python module and are gated with a clear error, the
+way the reference fails a missing plugin .so).
+"""
+
+from __future__ import annotations
+
+import bz2
+import lzma
+import zlib
+
+
+class CompressorError(Exception):
+    pass
+
+
+class Compressor:
+    """Base: subclasses define _compress/_decompress and name."""
+
+    name = ""
+
+    def compress(self, data: bytes) -> bytes:
+        return self._compress(bytes(data))
+
+    def decompress(self, data: bytes) -> bytes:
+        try:
+            return self._decompress(bytes(data))
+        except Exception as e:
+            raise CompressorError(
+                f"{self.name}: corrupt input: {e}") from e
+
+    @staticmethod
+    def create(name: str, **kw) -> "Compressor":
+        cls = _PLUGINS.get(name)
+        if cls is None:
+            if name in ("snappy", "lz4"):
+                raise CompressorError(
+                    f"compressor plugin {name}: backend library not "
+                    f"bundled in this runtime (use zstd/zlib/lzma/bz2)")
+            raise CompressorError(f"unknown compressor {name}")
+        return cls(**kw)
+
+    @staticmethod
+    def available() -> list[str]:
+        return sorted(_PLUGINS)
+
+
+class ZlibCompressor(Compressor):
+    name = "zlib"
+
+    def __init__(self, level: int = 5) -> None:
+        self.level = level
+
+    def _compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def _decompress(self, data: bytes) -> bytes:
+        return zlib.decompress(data)
+
+
+class ZstdCompressor(Compressor):
+    name = "zstd"
+
+    def __init__(self, level: int = 3) -> None:
+        import zstandard
+        self._c = zstandard.ZstdCompressor(level=level)
+        self._d = zstandard.ZstdDecompressor()
+
+    def _compress(self, data: bytes) -> bytes:
+        return self._c.compress(data)
+
+    def _decompress(self, data: bytes) -> bytes:
+        return self._d.decompress(data)
+
+
+class LzmaCompressor(Compressor):
+    name = "lzma"
+
+    def __init__(self, preset: int = 1) -> None:
+        self.preset = preset
+
+    def _compress(self, data: bytes) -> bytes:
+        return lzma.compress(data, preset=self.preset)
+
+    def _decompress(self, data: bytes) -> bytes:
+        return lzma.decompress(data)
+
+
+class Bz2Compressor(Compressor):
+    name = "bz2"
+
+    def __init__(self, level: int = 5) -> None:
+        self.level = level
+
+    def _compress(self, data: bytes) -> bytes:
+        return bz2.compress(data, self.level)
+
+    def _decompress(self, data: bytes) -> bytes:
+        return bz2.decompress(data)
+
+
+_PLUGINS = {c.name: c for c in (ZlibCompressor, ZstdCompressor,
+                                LzmaCompressor, Bz2Compressor)}
+
+__all__ = ["Compressor", "CompressorError"]

@@ -75,10 +75,12 @@ class Monitor:
     def __init__(self, rank: int = 0, peers: list[tuple[str, int]] | None = None,
                  store_path: str = ":memory:", secret: bytes | None = None,
                  config: dict | None = None,
-                 admin_socket_path: str | None = None) -> None:
+                 admin_socket_path: str | None = None,
+                 msgr_opts: dict | None = None) -> None:
         self.rank = rank
         self.peer_addrs = peers or []     # rank -> addr (incl. self slot)
-        self.msgr = Messenger(f"mon.{rank}", secret=secret)
+        self.msgr = Messenger(f"mon.{rank}", secret=secret,
+                              **(msgr_opts or {}))
         self.store = MonStore(store_path)
         self.osdmap = OSDMap()
         self.config = {

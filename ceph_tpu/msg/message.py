@@ -62,11 +62,89 @@ class Message:
                    seq=meta["seq"], from_name=meta["from"])
 
 
-async def read_frame(reader) -> bytes:
-    """Read one full frame from an asyncio StreamReader."""
-    hdr = await reader.readexactly(8)
-    if hdr[:4] != MAGIC:
+COMP_MAGIC = b"CTvC"     # on-wire compressed frame (compression_onwire)
+SEC_MAGIC = b"CTvE"      # AES-GCM encrypted frame (crypto_onwire secure mode)
+COMPRESS_THRESHOLD = 1024
+
+
+def _parse_plain(buf: bytes) -> bytes:
+    if buf[:4] != MAGIC:
         raise ValueError("bad magic")
+    return buf
+
+
+def wrap_frame(buf: bytes, compressor=None, aead=None) -> bytes:
+    """Apply the connection's negotiated on-wire transforms.
+
+    compress-then-encrypt, as ProtocolV2 layers compression inside the
+    secure session (compression_onwire.cc / crypto_onwire.cc); the
+    compressed form is only used when it actually shrinks the frame.
+    """
+    if compressor is not None and len(buf) > COMPRESS_THRESHOLD:
+        comp = compressor.compress(buf)
+        if len(comp) < len(buf):
+            buf = (COMP_MAGIC + struct.pack("<II", len(buf), len(comp))
+                   + comp)
+    if aead is not None:
+        import os as _os
+        nonce = _os.urandom(12)
+        ct = aead.encrypt(nonce, buf, b"")
+        buf = SEC_MAGIC + struct.pack("<I", len(ct)) + nonce + ct
+    return buf
+
+
+def unwrap_frame(buf: bytes, compressor=None) -> bytes:
+    """Undo COMP wrapping of an in-memory frame (post-decryption)."""
+    if buf[:4] == COMP_MAGIC:
+        raw_len, comp_len = struct.unpack_from("<II", buf, 4)
+        if raw_len > MAX_FRAME:
+            raise ValueError("oversized compressed frame")
+        if compressor is None:
+            raise ValueError("compressed frame on a plain connection")
+        try:
+            out = compressor.decompress(buf[12:12 + comp_len])
+        except Exception as e:
+            # corrupt input must look like any other framing error so
+            # the read loop's reconnect/teardown path handles it
+            raise ValueError(f"frame decompress failed: {e}") from e
+        if len(out) != raw_len:
+            raise ValueError("compressed frame length mismatch")
+        return _parse_plain(out)
+    return _parse_plain(buf)
+
+
+async def read_frame(reader, compressor=None, aead=None) -> bytes:
+    """Read one full (plain) frame from an asyncio StreamReader,
+    transparently unwrapping the connection's negotiated encryption
+    and compression layers."""
+    magic = await reader.readexactly(4)
+    if aead is not None and magic != SEC_MAGIC:
+        # a secure connection must never accept plaintext: an injected
+        # cleartext frame would bypass the channel's authentication
+        raise ValueError("plaintext frame on a secure connection")
+    if magic == SEC_MAGIC:
+        if aead is None:
+            raise ValueError("encrypted frame on a plain connection")
+        (ct_len,) = struct.unpack("<I", await reader.readexactly(4))
+        if ct_len > MAX_FRAME + 64:
+            raise ValueError("oversized encrypted frame")
+        nonce = await reader.readexactly(12)
+        ct = await reader.readexactly(ct_len)
+        try:
+            inner = aead.decrypt(nonce, ct, b"")
+        except Exception as e:
+            raise ValueError(f"frame decrypt failed: {e}") from e
+        return unwrap_frame(inner, compressor)
+    if magic == COMP_MAGIC:
+        lens = await reader.readexactly(8)
+        raw_len, comp_len = struct.unpack("<II", lens)
+        if max(raw_len, comp_len) > MAX_FRAME:
+            raise ValueError("oversized compressed frame")
+        comp = await reader.readexactly(comp_len)
+        return unwrap_frame(magic + lens + comp, compressor)
+    if magic != MAGIC:
+        raise ValueError("bad magic")
+    hdr = magic + await reader.readexactly(4)
     (meta_len,) = struct.unpack_from("<I", hdr, 4)
     if meta_len > MAX_FRAME:
         raise ValueError("oversized meta")

@@ -19,7 +19,7 @@ import struct
 from collections import deque
 from typing import Awaitable, Callable
 
-from .message import Message, read_frame
+from .message import Message, read_frame, wrap_frame
 
 Dispatcher = Callable[["Connection", Message], Awaitable[None]]
 
@@ -56,6 +56,13 @@ class Connection:
         self._ack_pending_bytes = 0
         self.closed = False
         self.generation = 0          # bumped per successful reconnect
+        # negotiated on-wire transforms (ProtocolV2 compression_onwire
+        # / crypto_onwire secure mode); set right after the handshake.
+        # PER-DIRECTION AEAD keys: one shared key would let a recorded
+        # client frame be reflected back to it as "authentic"
+        self.compressor = None
+        self.aead_tx = None
+        self.aead_rx = None
         self._send_lock = asyncio.Lock()
         self._reconnect_lock = asyncio.Lock()
         self._window_open = asyncio.Event()
@@ -97,8 +104,9 @@ class Connection:
             buf = msg.encode()
             self.unacked.append((msg, len(buf)))
             self.unacked_bytes += len(buf)
+            wire = wrap_frame(buf, self.compressor, self.aead_tx)
             try:
-                self.writer.write(buf)
+                self.writer.write(wire)
                 await self.writer.drain()
             except (ConnectionError, OSError):
                 if self.outgoing:
@@ -127,7 +135,8 @@ class Connection:
         ack = Message(ACK_TYPE, {"seq": self.in_seq})
         ack.from_name = self.messenger.name
         try:
-            self.writer.write(ack.encode())
+            self.writer.write(wrap_frame(ack.encode(), None,
+                                         self.aead_tx))
         except (ConnectionError, OSError):
             pass
 
@@ -141,7 +150,8 @@ class Connection:
 
     async def _resend_unacked(self) -> None:
         for msg, _ in list(self.unacked):
-            self.writer.write(msg.encode())
+            self.writer.write(wrap_frame(msg.encode(), self.compressor,
+                                         self.aead_tx))
         await self.writer.drain()
 
     async def close(self) -> None:
@@ -162,9 +172,18 @@ class Messenger:
                  max_unacked_msgs: int = 4096,
                  max_unacked_bytes: int = 64 << 20,
                  ack_every: int = ACK_EVERY,
-                 ack_bytes: int = ACK_BYTES) -> None:
+                 ack_bytes: int = ACK_BYTES,
+                 compression: str | None = None,
+                 secure: bool = False) -> None:
         self.name = name
         self.secret = secret
+        # on-wire transforms this endpoint OFFERS/accepts; the server
+        # picks during the handshake (ProtocolV2 negotiation)
+        self.compression = compression
+        self.secure = secure
+        if secure and secret is None:
+            raise ValueError("secure mode needs a shared secret "
+                             "(the AEAD key derives from it)")
         self.max_unacked_msgs = max_unacked_msgs
         self.max_unacked_bytes = max_unacked_bytes
         self.ack_every = ack_every
@@ -205,8 +224,8 @@ class Messenger:
             writer.close()
             return
         try:
-            peer_name, inst = await self._handshake_server_read(
-                reader, writer)
+            peer_name, inst, nego, hs_nonce, hs_cnonce = \
+                await self._handshake_server_read(reader, writer)
         except (asyncio.IncompleteReadError, ValueError, ConnectionError):
             writer.close()
             return
@@ -225,20 +244,62 @@ class Messenger:
             self._sessions.pop(peer_name, None)
         last_seq = self._sessions.get(peer_name, 0)
         try:
-            writer.write(b"ACK!" + struct.pack("<Q", last_seq))
+            nego_blob = json.dumps(nego).encode()
+            writer.write(b"ACK!" + struct.pack("<Q", last_seq)
+                         + struct.pack("<I", len(nego_blob)) + nego_blob)
             await writer.drain()
         except (ConnectionError, OSError):
             writer.close()
             return
         conn = Connection(self, peer_name, reader, writer, outgoing=False)
+        self._apply_negotiation(conn, nego, hs_nonce, hs_cnonce,
+                                is_server=True)
         conn.in_seq = last_seq
         self.conns_in[peer_name] = conn
         conn._read_task = asyncio.ensure_future(self._read_loop(conn))
 
     # -- handshake (HMAC challenge, cephx-lite) ------------------------------
-    async def _handshake_server_read(self, reader, writer) -> tuple[str, str]:
+    def _session_keys(self, nonce: bytes, cnonce: bytes, salt: bytes):
+        """Per-direction session keys from the full transcript: server
+        nonce + CLIENT nonce + salt (a replayed server hello cannot
+        force key reuse -- the client's nonce is fresh), with a
+        direction label (c2s/s2c) so the two streams never share a key
+        (cephx-style session key into AES-GCM, crypto_onwire.cc)."""
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        base = nonce + cnonce + salt
+
+        def key(label: bytes):
+            return AESGCM(hmac.new(self.secret,
+                                   b"ctv2-secure-" + label + base,
+                                   hashlib.sha256).digest())
+        return key(b"c2s"), key(b"s2c")
+
+    def _nego_mac(self, nego: dict, nonce: bytes,
+                  cnonce: bytes) -> str:
+        """Bind the negotiation to the shared secret: a MITM rewriting
+        the plaintext nego blob (encryption downgrade) fails the MAC."""
+        if self.secret is None:
+            return ""
+        blob = json.dumps({k: nego[k] for k in
+                           ("compression", "secure", "salt")},
+                          sort_keys=True).encode()
+        return hmac.new(self.secret, b"nego" + nonce + cnonce + blob,
+                        hashlib.sha256).hexdigest()
+
+    def _negotiate(self, offered: dict) -> dict:
+        """Server side: pick the on-wire transforms."""
+        comp = ""
+        if self.compression and self.compression in offered.get(
+                "compress", []):
+            comp = self.compression
+        secure = bool(offered.get("secure")) and self.secure \
+            and self.secret is not None
+        return {"compression": comp, "secure": secure,
+                "salt": os.urandom(16).hex()}
+
+    async def _handshake_server_read(self, reader, writer):
         """Server side up to (not including) the ACK: returns
-        (peer name, peer incarnation)."""
+        (peer name, peer incarnation, negotiated transforms, nonce)."""
         nonce = os.urandom(16)
         writer.write(HELLO_MAGIC + struct.pack("<16s", nonce))
         await writer.drain()
@@ -254,9 +315,39 @@ class Messenger:
                 writer.write(b"NACK")
                 await writer.drain()
                 raise ValueError("auth failure")
-        return payload["name"], payload.get("inst", "")
+        nego = self._negotiate(payload)
+        cnonce = bytes.fromhex(payload.get("cnonce", "")) or b"\0" * 16
+        nego["mac"] = self._nego_mac(nego, nonce, cnonce)
+        return payload["name"], payload.get("inst", ""), nego, \
+            nonce, cnonce
 
-    async def _handshake_client(self, reader, writer) -> None:
+    def _apply_negotiation(self, conn: Connection, nego: dict,
+                           nonce: bytes, cnonce: bytes,
+                           is_server: bool) -> None:
+        if conn.outgoing is is_server:
+            raise ValueError("negotiation direction mismatch")
+        if not is_server:
+            # client: verify the server's pick against the transcript
+            # MAC and refuse a downgrade of our secure requirement
+            want = self._nego_mac(nego, nonce, cnonce)
+            if want and not hmac.compare_digest(
+                    want, nego.get("mac", "")):
+                raise ValueError("negotiation MAC mismatch (tampered?)")
+            if self.secure and not nego.get("secure"):
+                raise ValueError(
+                    "peer refused secure mode (downgrade rejected)")
+        if nego.get("compression"):
+            from ..compressor import Compressor
+            conn.compressor = Compressor.create(nego["compression"])
+        if nego.get("secure"):
+            c2s, s2c = self._session_keys(nonce, cnonce,
+                                          bytes.fromhex(nego["salt"]))
+            if is_server:
+                conn.aead_rx, conn.aead_tx = c2s, s2c
+            else:
+                conn.aead_tx, conn.aead_rx = c2s, s2c
+
+    async def _handshake_client(self, reader, writer):
         hdr = await reader.readexactly(20)
         if hdr[:4] != HELLO_MAGIC:
             raise ValueError("bad hello")
@@ -264,16 +355,21 @@ class Messenger:
         proof = b""
         if self.secret is not None:
             proof = hmac.new(self.secret, nonce, hashlib.sha256).digest()
-        payload = json.dumps({"name": self.name,
-                              "inst": self.incarnation,
-                              "proof": proof.hex()}).encode()
+        cnonce = os.urandom(16)
+        payload = json.dumps({
+            "name": self.name, "inst": self.incarnation,
+            "proof": proof.hex(), "cnonce": cnonce.hex(),
+            "compress": [self.compression] if self.compression else [],
+            "secure": self.secure}).encode()
         writer.write(HELLO_MAGIC + struct.pack("<I", len(payload)) + payload)
         await writer.drain()
         ack = await reader.readexactly(4)
         if ack != b"ACK!":
             raise ConnectionError("auth rejected")
         (last_seq,) = struct.unpack("<Q", await reader.readexactly(8))
-        return last_seq
+        (nego_len,) = struct.unpack("<I", await reader.readexactly(4))
+        nego = json.loads(await reader.readexactly(nego_len))
+        return last_seq, nego, nonce, cnonce
 
     # -- client -------------------------------------------------------------
     async def connect(self, addr: tuple[str, int],
@@ -299,9 +395,12 @@ class Messenger:
                 replay = [m for m, _ in conn.unacked]
             reader, writer = await asyncio.open_connection(
                 addr[0], addr[1])
-            last_seq = await self._handshake_client(reader, writer)
+            last_seq, nego, hs_nonce, hs_cnonce = \
+                await self._handshake_client(reader, writer)
             conn = Connection(self, peer_name, reader, writer,
                               outgoing=True, peer_addr=addr)
+            self._apply_negotiation(conn, nego, hs_nonce, hs_cnonce,
+                                    is_server=False)
             # continue the server's seq space: a same-incarnation
             # session survives connection churn, and starting below
             # last_seq would get every message deduped as a replay
@@ -334,7 +433,10 @@ class Messenger:
                 try:
                     reader, writer = await asyncio.open_connection(
                         conn.peer_addr[0], conn.peer_addr[1])
-                    last_seq = await self._handshake_client(reader, writer)
+                    last_seq, nego, hs_nonce, hs_cnonce = \
+                        await self._handshake_client(reader, writer)
+                    self._apply_negotiation(conn, nego, hs_nonce,
+                                            hs_cnonce, is_server=False)
                     conn._trim_acked(last_seq)
                     conn.reader, conn.writer = reader, writer
                     # server->client stream restarts on the new accept
@@ -360,7 +462,8 @@ class Messenger:
     async def _read_loop(self, conn: Connection) -> None:
         try:
             while not conn.closed:
-                buf = await read_frame(conn.reader)
+                buf = await read_frame(conn.reader, conn.compressor,
+                                       conn.aead_rx)
                 msg = Message.decode(buf)
                 if msg.type == ACK_TYPE:   # control frame, outside seq space
                     conn._trim_acked(int(msg.data.get("seq", 0)))

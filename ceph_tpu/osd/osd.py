@@ -28,7 +28,9 @@ class OSD:
                  store=None, host: str = "host0",
                  secret: bytes | None = None,
                  config: dict | None = None,
-                 admin_socket_path: str | None = None) -> None:
+                 admin_socket_path: str | None = None,
+                 msgr_opts: dict | None = None) -> None:
+        self.msgr_opts = msgr_opts
         self.host = host
         self.store = store or MemStore()
         # identity lives in the store (OSD superblock analog,
@@ -128,7 +130,8 @@ class OSD:
         self.store.mount()
         name = f"osd.{self.whoami}" if self.whoami >= 0 else \
             f"osd-boot-{self.uuid[:8]}"
-        self.msgr = Messenger(name, secret=self.secret)
+        self.msgr = Messenger(name, secret=self.secret,
+                              **(self.msgr_opts or {}))
         self.msgr.add_dispatcher(self._dispatch)
         addr = await self.msgr.bind(host, port)
         ack = await self._mon_request(
@@ -574,8 +577,11 @@ class OSD:
                 self.conf.set(name, value)
                 applied.add(name)
             except ValueError:
-                # KNOWN option, invalid value: reject -- a raw string
-                # in the hot-path dict would blow up comparisons later
+                # KNOWN option, invalid value: reject the NEW value --
+                # but keep tracking the key if an earlier push set it,
+                # or a later `config rm` could never revert it
+                if name in pushed:
+                    applied.add(name)
                 continue
             except KeyError:
                 # unschema'd option: best-effort numeric cast so hot

@@ -31,9 +31,9 @@ def test_config_auth_log_health():
                                "name": "osd_max_backfills",
                                "value": "5"})
             await wait_for(
-                lambda: osds[0].config["osd_max_backfills"] == 5,
-                msg="config push to osd.0")
-            assert osds[2].config["osd_max_backfills"] == 5
+                lambda: all(o.config["osd_max_backfills"] == 5
+                            for o in osds),
+                msg="config push to all osds")
             got = await rados.mon_command("config get", {"who": "osd.1"})
             assert got["osd_max_backfills"] == "5"
             dump = await rados.mon_command("config dump", {})

@@ -39,10 +39,11 @@ class Compressor:
     def create(name: str, **kw) -> "Compressor":
         cls = _PLUGINS.get(name)
         if cls is None:
-            if name in ("snappy", "lz4"):
+            if name in ("snappy", "lz4", "zstd"):
                 raise CompressorError(
                     f"compressor plugin {name}: backend library not "
-                    f"bundled in this runtime (use zstd/zlib/lzma/bz2)")
+                    f"available in this runtime "
+                    f"(have {sorted(_PLUGINS)})")
             raise CompressorError(f"unknown compressor {name}")
         return cls(**kw)
 
@@ -64,13 +65,21 @@ class ZlibCompressor(Compressor):
         return zlib.decompress(data)
 
 
+try:
+    import zstandard as _zstandard
+except ImportError:        # registry gates the plugin cleanly below
+    _zstandard = None
+
+
 class ZstdCompressor(Compressor):
     name = "zstd"
 
     def __init__(self, level: int = 3) -> None:
-        import zstandard
-        self._c = zstandard.ZstdCompressor(level=level)
-        self._d = zstandard.ZstdDecompressor()
+        if _zstandard is None:
+            raise CompressorError(
+                "compressor plugin zstd: zstandard not installed")
+        self._c = _zstandard.ZstdCompressor(level=level)
+        self._d = _zstandard.ZstdDecompressor()
 
     def _compress(self, data: bytes) -> bytes:
         return self._c.compress(data)
@@ -105,7 +114,9 @@ class Bz2Compressor(Compressor):
         return bz2.decompress(data)
 
 
-_PLUGINS = {c.name: c for c in (ZlibCompressor, ZstdCompressor,
-                                LzmaCompressor, Bz2Compressor)}
+_PLUGINS = {c.name: c for c in (ZlibCompressor, LzmaCompressor,
+                                Bz2Compressor)}
+if _zstandard is not None:
+    _PLUGINS["zstd"] = ZstdCompressor
 
 __all__ = ["Compressor", "CompressorError"]

@@ -104,6 +104,123 @@ class PgAutoscalerModule(MgrModule):
         return out
 
 
+class PrometheusModule(MgrModule):
+    """GET /metrics exposition (src/pybind/mgr/prometheus analog):
+    cluster state from the osdmap + per-daemon perf counters from the
+    DaemonServer reports."""
+
+    name = "prometheus"
+
+    def __init__(self, mgr: "Mgr") -> None:
+        super().__init__(mgr)
+        self.server = None
+        self.addr: tuple[str, int] | None = None
+
+    async def serve(self) -> None:
+        from .prometheus import MetricsHttpServer
+        self.server = MetricsHttpServer(self.render)
+        self.addr = await self.server.start(
+            port=self.mgr.config.get("prometheus_port", 0))
+        try:
+            await asyncio.Event().wait()      # serve until cancelled
+        except asyncio.CancelledError:
+            await self.server.stop()
+            raise
+
+    async def render(self) -> str:
+        from .prometheus import (
+            families_from_perf, merge_families, render_metrics,
+        )
+        m = self.mgr
+        osd_up = {"help": "OSD up state", "type": "gauge",
+                  "samples": [({"ceph_daemon": f"osd.{o}"},
+                               1 if i.up else 0)
+                              for o, i in m.osdmap.osds.items()]}
+        osd_in = {"help": "OSD in state", "type": "gauge",
+                  "samples": [({"ceph_daemon": f"osd.{o}"},
+                               1 if i.in_cluster else 0)
+                              for o, i in m.osdmap.osds.items()]}
+        pools = {"help": "pool pg_num", "type": "gauge",
+                 "samples": [({"pool": p.name}, p.pg_num)
+                             for p in m.osdmap.pools.values()]}
+        epoch = {"help": "osdmap epoch", "type": "counter",
+                 "samples": [({}, m.osdmap.epoch)]}
+        perf = [families_from_perf(name, rep.get("summary", {}),
+                                   prefix="ceph_daemon")
+                for name, rep in m.daemon_reports.items()]
+        return render_metrics(merge_families(
+            {"ceph_osd_up": osd_up, "ceph_osd_in": osd_in,
+             "ceph_pool_pg_num": pools, "ceph_osdmap_epoch": epoch},
+            *perf))
+
+    async def handle_command(self, cmd: str, args: dict):
+        if cmd == "status":
+            return {"addr": list(self.addr) if self.addr else None}
+        raise ValueError(f"unknown prometheus command {cmd!r}")
+
+
+class ProgressModule(MgrModule):
+    """Recovery/backfill progress events (src/pybind/mgr/progress):
+    watches the missing-object counts daemons report; an event opens
+    when recovery work appears, tracks the high-water mark, and
+    completes when the count drains to zero."""
+
+    name = "progress"
+
+    def __init__(self, mgr: "Mgr") -> None:
+        super().__init__(mgr)
+        self.events: dict[str, dict] = {}
+        self._serial = 0
+
+    def _total_missing(self) -> int:
+        return sum(rep.get("summary", {}).get("missing_objects", 0)
+                   for rep in self.mgr.daemon_reports.values())
+
+    async def serve(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                self._tick()
+            except Exception:
+                pass
+
+    def _tick(self) -> None:
+        missing = self._total_missing()
+        open_ev = next((e for e in self.events.values()
+                        if not e["done"]), None)
+        if missing > 0 and open_ev is None:
+            self._serial += 1
+            self.events[f"ev{self._serial}"] = {
+                "message": "Recovering degraded objects",
+                "started": time.monotonic(), "peak": missing,
+                "remaining": missing, "progress": 0.0, "done": False}
+        elif open_ev is not None:
+            open_ev["peak"] = max(open_ev["peak"], missing)
+            open_ev["remaining"] = missing
+            open_ev["progress"] = round(
+                1.0 - missing / max(open_ev["peak"], 1), 3)
+            if missing == 0:
+                open_ev["done"] = True
+                open_ev["progress"] = 1.0
+                open_ev["finished"] = time.monotonic()
+        # completed events linger for 5 minutes AFTER completion (aging
+        # by start time would delete a long recovery's event instantly)
+        now = time.monotonic()
+        for key in [k for k, e in self.events.items()
+                    if e["done"] and now - e.get("finished", now) > 300]:
+            del self.events[key]
+
+    async def handle_command(self, cmd: str, args: dict):
+        if cmd == "show":
+            return {k: {kk: vv for kk, vv in e.items()
+                        if kk not in ("started", "finished")}
+                    for k, e in self.events.items()}
+        if cmd == "clear":
+            self.events.clear()
+            return ""
+        raise ValueError(f"unknown progress command {cmd!r}")
+
+
 class StatusModule(MgrModule):
     name = "status"
 
@@ -122,9 +239,12 @@ class StatusModule(MgrModule):
 
 class Mgr:
     def __init__(self, name: str = "x",
-                 config: dict | None = None) -> None:
+                 config: dict | None = None,
+                 secret: bytes | None = None,
+                 msgr_opts: dict | None = None) -> None:
         self.name = name
-        self.msgr = Messenger(f"mgr.{name}")
+        self.msgr = Messenger(f"mgr.{name}", secret=secret,
+                              **(msgr_opts or {}))
         self.osdmap = OSDMap()
         self.mon_addr: tuple[str, int] | None = None
         self.config = {
@@ -138,7 +258,8 @@ class Mgr:
         self.daemon_reports: dict[str, dict] = {}
         self.log: list[str] = []
         self.modules: dict[str, MgrModule] = {}
-        for cls in (BalancerModule, PgAutoscalerModule, StatusModule):
+        for cls in (BalancerModule, PgAutoscalerModule, StatusModule,
+                    PrometheusModule, ProgressModule):
             mod = cls(self)
             self.modules[mod.name] = mod
         self._tasks: list[asyncio.Task] = []

@@ -65,6 +65,11 @@ class Message:
 COMP_MAGIC = b"CTvC"     # on-wire compressed frame (compression_onwire)
 SEC_MAGIC = b"CTvE"      # AES-GCM encrypted frame (crypto_onwire secure mode)
 COMPRESS_THRESHOLD = 1024
+# a plain frame may carry meta and segments EACH up to MAX_FRAME; the
+# wrapped paths must accept at least that (a tighter cap would reject
+# on receive a frame the sender legally built -> teardown/replay loop)
+MAX_WRAPPED = 2 * MAX_FRAME + 65536
+OFFLOAD_THRESHOLD = 1 << 20     # executor offload for >1 MiB transforms
 
 
 def _parse_plain(buf: bytes) -> bytes:
@@ -97,7 +102,7 @@ def unwrap_frame(buf: bytes, compressor=None) -> bytes:
     """Undo COMP wrapping of an in-memory frame (post-decryption)."""
     if buf[:4] == COMP_MAGIC:
         raw_len, comp_len = struct.unpack_from("<II", buf, 4)
-        if raw_len > MAX_FRAME:
+        if raw_len > MAX_WRAPPED:
             raise ValueError("oversized compressed frame")
         if compressor is None:
             raise ValueError("compressed frame on a plain connection")
@@ -126,19 +131,28 @@ async def read_frame(reader, compressor=None, aead=None) -> bytes:
         if aead is None:
             raise ValueError("encrypted frame on a plain connection")
         (ct_len,) = struct.unpack("<I", await reader.readexactly(4))
-        if ct_len > MAX_FRAME + 64:
+        if ct_len > MAX_WRAPPED:
             raise ValueError("oversized encrypted frame")
         nonce = await reader.readexactly(12)
         ct = await reader.readexactly(ct_len)
         try:
-            inner = aead.decrypt(nonce, ct, b"")
+            if ct_len > OFFLOAD_THRESHOLD:
+                # big decrypts off the event loop: heartbeats must not
+                # stall behind a multi-MB AES pass
+                import asyncio as _asyncio
+                inner = await _asyncio.get_event_loop().run_in_executor(
+                    None, aead.decrypt, nonce, ct, b"")
+            else:
+                inner = aead.decrypt(nonce, ct, b"")
+        except ValueError:
+            raise
         except Exception as e:
             raise ValueError(f"frame decrypt failed: {e}") from e
         return unwrap_frame(inner, compressor)
     if magic == COMP_MAGIC:
         lens = await reader.readexactly(8)
         raw_len, comp_len = struct.unpack("<II", lens)
-        if max(raw_len, comp_len) > MAX_FRAME:
+        if max(raw_len, comp_len) > MAX_WRAPPED:
             raise ValueError("oversized compressed frame")
         comp = await reader.readexactly(comp_len)
         return unwrap_frame(magic + lens + comp, compressor)

@@ -104,7 +104,17 @@ class Connection:
             buf = msg.encode()
             self.unacked.append((msg, len(buf)))
             self.unacked_bytes += len(buf)
-            wire = wrap_frame(buf, self.compressor, self.aead_tx)
+            from .message import OFFLOAD_THRESHOLD
+            if (self.compressor or self.aead_tx) \
+                    and len(buf) > OFFLOAD_THRESHOLD:
+                # multi-MB compress/encrypt off the event loop so
+                # heartbeat handling doesn't stall behind it; ordering
+                # is preserved -- we still hold the send lock
+                wire = await asyncio.get_event_loop().run_in_executor(
+                    None, wrap_frame, buf, self.compressor,
+                    self.aead_tx)
+            else:
+                wire = wrap_frame(buf, self.compressor, self.aead_tx)
             try:
                 self.writer.write(wire)
                 await self.writer.drain()
@@ -316,6 +326,12 @@ class Messenger:
                 await writer.drain()
                 raise ValueError("auth failure")
         nego = self._negotiate(payload)
+        if self.secure and not nego["secure"]:
+            # the server's secure requirement binds BOTH directions: a
+            # peer that won't (or can't) encrypt gets no session at all
+            writer.write(b"NACK")
+            await writer.drain()
+            raise ValueError("peer did not offer secure mode")
         cnonce = bytes.fromhex(payload.get("cnonce", "")) or b"\0" * 16
         nego["mac"] = self._nego_mac(nego, nonce, cnonce)
         return payload["name"], payload.get("inst", ""), nego, \
@@ -326,6 +342,12 @@ class Messenger:
                            is_server: bool) -> None:
         if conn.outgoing is is_server:
             raise ValueError("negotiation direction mismatch")
+        # a RE-negotiation (reconnect) replaces the transforms wholesale:
+        # keeping a stale compressor after the peer stopped offering it
+        # would emit frames the peer can no longer parse
+        conn.compressor = None
+        conn.aead_tx = None
+        conn.aead_rx = None
         if not is_server:
             # client: verify the server's pick against the transcript
             # MAC and refuse a downgrade of our secure requirement
@@ -450,6 +472,12 @@ class Messenger:
                     return
                 except (ConnectionError, OSError):
                     await asyncio.sleep(0.05 * (2 ** attempt))
+                except ValueError:
+                    # negotiation failure (MAC mismatch, downgrade,
+                    # unknown compressor): retrying cannot help; close
+                    # so connect() replaces the conn instead of
+                    # returning a zombie forever
+                    break
             await conn.close()
             raise ConnectionError(f"reconnect to {conn.peer_name} failed")
 

@@ -628,6 +628,20 @@ class OSD:
                     summary[key] = v.get("value", v) \
                         if isinstance(v, dict) else v
             summary["num_pgs"] = len(self.pgs)
+            # recovery/backfill state for the mgr progress module
+            # (pg stats feeding progress events in the reference)
+            states: dict[str, int] = {}
+            missing = 0
+            backfills = 0
+            for pg in self.pgs.values():
+                states[pg.state] = states.get(pg.state, 0) + 1
+                if pg.is_primary():
+                    missing += len(pg.missing) + sum(
+                        len(ms) for ms in pg.peer_missing.values())
+                    backfills += len(pg.backfill_targets)
+            summary["pg_states"] = states
+            summary["missing_objects"] = missing
+            summary["backfills"] = backfills
         except Exception:
             return
         try:

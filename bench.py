@@ -25,7 +25,9 @@ Harness discipline (round-2 fixes):
 import json
 import os
 import signal
+import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -57,6 +59,34 @@ def _alarm(signum, frame):  # backstop: never die without the JSON line
     RESULT.setdefault("error", "hard deadline")
     emit()
     os._exit(3)
+
+
+def _watchdog(deadline: float) -> None:
+    """Thread backstop: SIGALRM only fires between bytecodes of the
+    main thread, so a backend init hung inside a C call (dead TPU
+    tunnel) would block it forever.  A thread still runs -- it prints
+    the JSON line and hard-exits."""
+    while time.monotonic() < deadline + 45:
+        time.sleep(1.0)
+        if _EMITTED:
+            return
+    log("WATCHDOG: main thread wedged (backend hang?); emitting")
+    RESULT.setdefault("error", "watchdog: backend hang")
+    emit()
+    os._exit(4)
+
+
+def _backend_reachable(timeout: float = 90.0) -> bool:
+    """Probe jax backend init in a CHILD process: if the TPU tunnel is
+    dead the init blocks uninterruptibly, and only a process boundary
+    lets us time it out."""
+    code = "import jax; jax.devices(); print('up')"
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             timeout=timeout, capture_output=True)
+        return b"up" in res.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
 
 
 def _device_batch(rng, batch, k, chunk):
@@ -105,8 +135,21 @@ def main() -> int:
     deadline = T0 + float(os.environ.get("BENCH_DEADLINE_S", "270"))
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(int(deadline - T0 + 60))
+    threading.Thread(target=_watchdog, args=(deadline,),
+                     daemon=True).start()
 
     log(f"start: k={k} m={m} stripe={stripe} batch={batch}")
+    log("probing backend reachability (child process)")
+    probe_budget = min(90.0, max(20.0, deadline - time.monotonic() - 60))
+    if not _backend_reachable(probe_budget):
+        # one retry: transient tunnel contention resolves in minutes
+        log("backend probe failed; retrying once")
+        time.sleep(min(30, max(0, deadline - time.monotonic() - 90)))
+        if not _backend_reachable(probe_budget):
+            RESULT["error"] = "tpu backend unreachable (tunnel down)"
+            emit()
+            return 1
+    log("backend probe ok")
     from ceph_tpu.gf import gen_rs_matrix, gf_matmul
     from ceph_tpu.native import gf8_matmul
     from ceph_tpu.ec import registry

@@ -148,9 +148,17 @@ class PrometheusModule(MgrModule):
         perf = [families_from_perf(name, rep.get("summary", {}),
                                    prefix="ceph_daemon")
                 for name, rep in m.daemon_reports.items()]
+        pg_states = {"help": "PG count by state per daemon",
+                     "type": "gauge", "samples": []}
+        for name, rep in m.daemon_reports.items():
+            for state, n in rep.get("summary", {}).get(
+                    "pg_states", {}).items():
+                pg_states["samples"].append(
+                    ({"ceph_daemon": name, "state": state}, n))
         return render_metrics(merge_families(
             {"ceph_osd_up": osd_up, "ceph_osd_in": osd_in,
-             "ceph_pool_pg_num": pools, "ceph_osdmap_epoch": epoch},
+             "ceph_pool_pg_num": pools, "ceph_osdmap_epoch": epoch,
+             "ceph_pg_states": pg_states},
             *perf))
 
     async def handle_command(self, cmd: str, args: dict):
@@ -172,17 +180,24 @@ class ProgressModule(MgrModule):
         self.events: dict[str, dict] = {}
         self._serial = 0
 
+    STALE_REPORT_S = 30.0
+
     def _total_missing(self) -> int:
+        # a daemon that died mid-recovery leaves its last report behind
+        # forever (nothing prunes daemon_reports); counting it would pin
+        # an event open and block all future ones
+        now = time.monotonic()
         return sum(rep.get("summary", {}).get("missing_objects", 0)
-                   for rep in self.mgr.daemon_reports.values())
+                   for rep in self.mgr.daemon_reports.values()
+                   if now - rep.get("stamp", 0) < self.STALE_REPORT_S)
 
     async def serve(self) -> None:
         while True:
             await asyncio.sleep(1.0)
             try:
                 self._tick()
-            except Exception:
-                pass
+            except Exception as e:
+                self.mgr.log.append(f"progress: {type(e).__name__}: {e}")
 
     def _tick(self) -> None:
         missing = self._total_missing()

@@ -40,8 +40,9 @@ def families_from_perf(daemon: str, counters: dict,
     fams: dict[str, dict] = {}
     for key, val in counters.items():
         if isinstance(val, dict):
-            val = val.get("value", 0)
-        if not isinstance(val, (int, float)):
+            val = val.get("value")     # non-counter dicts are skipped,
+        if not isinstance(val, (int, float)) \
+                or isinstance(val, bool):   # not coerced to a bogus 0
             continue
         name = f"{prefix}_{key}"
         fams.setdefault(name, {"help": f"perf counter {key}",
@@ -84,9 +85,16 @@ class MetricsHttpServer:
 
     async def _conn(self, reader, writer) -> None:
         try:
-            line = await asyncio.wait_for(reader.readline(), 10)
-            while True:
-                h = await asyncio.wait_for(reader.readline(), 10)
+            # one overall deadline for the whole request: a per-line
+            # timeout lets a byte-dripping client hold the task forever
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + 15.0
+            line = await asyncio.wait_for(
+                reader.readline(), deadline - loop.time())
+            for _ in range(200):           # header-count cap
+                h = await asyncio.wait_for(
+                    reader.readline(),
+                    max(0.1, deadline - loop.time()))
                 if h in (b"\r\n", b"\n", b""):
                     break
             path = line.split()[1].decode() if len(line.split()) > 1 \
@@ -106,7 +114,8 @@ class MetricsHttpServer:
             writer.write(body)
             await writer.drain()
         except (ConnectionError, asyncio.TimeoutError,
-                asyncio.IncompleteReadError, IndexError):
+                asyncio.IncompleteReadError, IndexError, ValueError):
+            # ValueError covers LimitOverrunError (oversized lines)
             pass
         finally:
             writer.close()

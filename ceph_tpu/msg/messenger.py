@@ -109,10 +109,20 @@ class Connection:
                     and len(buf) > OFFLOAD_THRESHOLD:
                 # multi-MB compress/encrypt off the event loop so
                 # heartbeat handling doesn't stall behind it; ordering
-                # is preserved -- we still hold the send lock
+                # is preserved -- we still hold the send lock.  The
+                # await opens a window where a RECONNECT can swap the
+                # writer and renegotiate keys: snapshot the generation
+                # and, if it moved, skip the write -- the message is
+                # already in unacked and _resend_unacked will re-wrap
+                # it with the NEW transforms.
+                gen = self.generation
                 wire = await asyncio.get_event_loop().run_in_executor(
                     None, wrap_frame, buf, self.compressor,
                     self.aead_tx)
+                if self.generation != gen:
+                    return
+                if self.closed:
+                    raise ConnectionError(f"{self.peer_name} closed")
             else:
                 wire = wrap_frame(buf, self.compressor, self.aead_tx)
             try:
@@ -359,8 +369,13 @@ class Messenger:
                 raise ValueError(
                     "peer refused secure mode (downgrade rejected)")
         if nego.get("compression"):
-            from ..compressor import Compressor
-            conn.compressor = Compressor.create(nego["compression"])
+            from ..compressor import Compressor, CompressorError
+            try:
+                conn.compressor = Compressor.create(nego["compression"])
+            except CompressorError as e:
+                # normalize to the error type every negotiation-failure
+                # path already handles (close, don't retry)
+                raise ValueError(str(e)) from e
         if nego.get("secure"):
             c2s, s2c = self._session_keys(nonce, cnonce,
                                           bytes.fromhex(nego["salt"]))

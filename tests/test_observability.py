@@ -100,7 +100,8 @@ def test_progress_module_event_lifecycle():
         try:
             prog = mgr.modules["progress"]
             mgr.daemon_reports["osd.0"] = {
-                "stamp": 0, "summary": {"missing_objects": 40}}
+                "stamp": __import__("time").monotonic(),
+                "summary": {"missing_objects": 40}}
             prog._tick()
             assert len(prog.events) == 1
             ev = next(iter(prog.events.values()))
@@ -121,6 +122,10 @@ def test_progress_module_event_lifecycle():
                        if not e["done"]) == 1
             out = await prog.handle_command("show", {})
             assert len(out) == 2
+            # a dead daemon's stale report must not pin the event open
+            mgr.daemon_reports["osd.0"]["stamp"] -= 60
+            prog._tick()
+            assert all(e["done"] for e in prog.events.values())
         finally:
             await mgr.stop()
             await teardown(mon, osds)

@@ -70,6 +70,8 @@ def _watchdog(deadline: float) -> None:
         time.sleep(1.0)
         if _EMITTED:
             return
+    if _EMITTED:      # close the race: main emitted during the check
+        return
     log("WATCHDOG: main thread wedged (backend hang?); emitting")
     RESULT.setdefault("error", "watchdog: backend hang")
     emit()

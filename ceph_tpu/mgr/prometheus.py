@@ -92,9 +92,10 @@ class MetricsHttpServer:
             line = await asyncio.wait_for(
                 reader.readline(), deadline - loop.time())
             for _ in range(200):           # header-count cap
-                h = await asyncio.wait_for(
-                    reader.readline(),
-                    max(0.1, deadline - loop.time()))
+                remaining = deadline - loop.time()
+                if remaining <= 0:         # HARD deadline: a dripper
+                    return                 # cannot stretch it per-line
+                h = await asyncio.wait_for(reader.readline(), remaining)
                 if h in (b"\r\n", b"\n", b""):
                     break
             path = line.split()[1].decode() if len(line.split()) > 1 \

@@ -125,6 +125,12 @@ class Connection:
                     raise ConnectionError(f"{self.peer_name} closed")
             else:
                 wire = wrap_frame(buf, self.compressor, self.aead_tx)
+            from ..common.throttle import injector as _fault
+            if _fault.check("ms_inject_socket_failures"):
+                # chaos: drop the transport mid-send; the lossless
+                # reconnect+replay machinery must absorb it
+                # (ms_inject_socket_failures, qa msgr-failures suites)
+                self.writer.close()
             try:
                 self.writer.write(wire)
                 await self.writer.drain()

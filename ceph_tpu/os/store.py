@@ -160,6 +160,8 @@ class MemStore(ObjectStore):
             raise ValueError(f"unknown op {op.op}")
 
     def read(self, coll, oid, offset=0, length=None):
+        from ..common.throttle import injector
+        injector.maybe_raise("objectstore_read")   # EIO injection site
         o = self._colls.get(coll, {}).get(oid)
         if o is None:
             raise FileNotFoundError(f"{coll}/{oid}")
@@ -346,6 +348,8 @@ class DBStore(ObjectStore):
             raise ValueError(f"unknown op {op.op}")
 
     def read(self, coll, oid, offset=0, length=None):
+        from ..common.throttle import injector
+        injector.maybe_raise("objectstore_read")   # EIO injection site
         data = self._get_data(self._conn(), coll, oid)
         if data is None:
             raise FileNotFoundError(f"{coll}/{oid}")

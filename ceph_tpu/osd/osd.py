@@ -59,6 +59,13 @@ class OSD:
         # pre-override snapshot: central-config removals revert to this
         self._base_config = dict(self.config)
         self._pushed_config: set[str] = set()
+        # in-flight client payload byte cap (Throttle backpressure,
+        # osd_client_message_size_cap = 500 MiB in the reference)
+        from ..common.throttle import Throttle
+        self.client_throttle = Throttle(
+            "osd_client_bytes",
+            int(self.config.get("osd_client_message_size_cap",
+                                500 << 20)))
         # typed registry over the same values: admin-socket `config set`
         # flows through the schema validation and back into the dict the
         # hot paths read (ConfigProxy observer pattern)
@@ -670,6 +677,19 @@ class OSD:
     # client I/O
     async def _h_osd_op(self, conn, msg) -> None:
         await self.admit(OpClass.CLIENT)
+        # byte throttle on in-flight client payloads
+        # (osd_client_message_size_cap backpressure); the limit re-reads
+        # config so runtime `config set` takes effect
+        self.client_throttle.limit = int(
+            self.config.get("osd_client_message_size_cap", 500 << 20))
+        nbytes = sum(len(s) for s in msg.segments)
+        await self.client_throttle.get(nbytes)
+        try:
+            await self._do_osd_op(conn, msg)
+        finally:
+            self.client_throttle.put(nbytes)
+
+    async def _do_osd_op(self, conn, msg) -> None:
         pg = self._get_pg(msg.data["pgid"])
         if pg is None:
             await conn.send(Message(

@@ -1,0 +1,116 @@
+"""osdmaptool analog (src/tools/osdmaptool.cc): offline OSDMap
+inspection and placement simulation.
+
+    # snapshot a live map, then work offline
+    python -m ceph_tpu.tools.ceph_cli -m H:P --format json osd dump > map.json
+    python -m ceph_tpu.tools.osdmaptool map.json --print
+    python -m ceph_tpu.tools.osdmaptool map.json --test-map-pgs
+    python -m ceph_tpu.tools.osdmaptool map.json --upmap out.txt
+
+--test-map-pgs maps every PG of every pool through the placement
+pipeline and prints the per-OSD distribution (the reference's
+workload-simulation mode); --upmap computes balancer upmap items and
+writes the equivalent CLI commands (osdmaptool --upmap).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..mon.osdmap import OSDMap
+
+
+def load_map(path: str) -> OSDMap:
+    with open(path) as f:
+        return OSDMap.from_dict(json.load(f))
+
+
+def cmd_print(m: OSDMap) -> None:
+    print(f"epoch {m.epoch}")
+    print(f"max_osd {m.max_osd}")
+    for pid, pool in sorted(m.pools.items()):
+        print(f"pool {pid} '{pool.name}' type {pool.type} "
+              f"size {pool.size} min_size {pool.min_size} "
+              f"pg_num {pool.pg_num}")
+    for o, info in sorted(m.osds.items()):
+        state = ("up" if info.up else "down") + \
+                ("+in" if info.in_cluster else "+out")
+        print(f"osd.{o} {state} weight "
+              f"{info.weight / 0x10000:.5f} host {info.host}")
+    if m.pg_temp:
+        print(f"pg_temp entries: {len(m.pg_temp)}")
+    if m.pg_upmap_items:
+        print(f"pg_upmap_items entries: {len(m.pg_upmap_items)}")
+
+
+def cmd_test_map_pgs(m: OSDMap, pool_filter: int | None) -> None:
+    counts: dict[int, int] = {}
+    total = 0
+    sizes: dict[int, int] = {}
+    for pid, pool in sorted(m.pools.items()):
+        if pool_filter is not None and pid != pool_filter:
+            continue
+        for ps in range(pool.pg_num):
+            up = [o for o in m.pg_to_up_acting_osds(pid, ps) if o >= 0]
+            total += 1
+            sizes[len(up)] = sizes.get(len(up), 0) + 1
+            for o in up:
+                counts[o] = counts.get(o, 0) + 1
+    print(f"pool pg count: {total}")
+    for size, n in sorted(sizes.items()):
+        print(f"size {size}\t{n}")
+    if counts:
+        vals = list(counts.values())
+        avg = sum(vals) / len(vals)
+        dev = (sum((v - avg) ** 2 for v in vals) / len(vals)) ** 0.5
+        for o in sorted(counts):
+            print(f"osd.{o}\t{counts[o]}")
+        print(f"avg {avg:.1f} stddev {dev:.2f} "
+              f"min {min(vals)} max {max(vals)}")
+
+
+def cmd_upmap(m: OSDMap, out_path: str, max_items: int) -> None:
+    from ..mgr.balancer import compute_upmaps
+    upmaps = compute_upmaps(m, max_moves=max_items)
+    lines = []
+    for pgid, items in sorted(upmaps.items()):
+        pairs = " ".join(f"{a} {b}" for a, b in items)
+        lines.append(f"ceph osd pg-upmap-items {pgid} {pairs}")
+    out = "\n".join(lines) + ("\n" if lines else "")
+    if out_path == "-":
+        sys.stdout.write(out)
+    else:
+        with open(out_path, "w") as f:
+            f.write(out)
+    print(f"wrote {len(lines)} upmap item commands", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="osdmaptool")
+    p.add_argument("map", help="osdmap json (ceph osd dump output)")
+    p.add_argument("--print", action="store_true", dest="do_print")
+    p.add_argument("--test-map-pgs", action="store_true")
+    p.add_argument("--pool", type=int)
+    p.add_argument("--upmap", metavar="FILE")
+    p.add_argument("--upmap-max", type=int, default=10)
+    args = p.parse_args(argv)
+    m = load_map(args.map)
+    did = False
+    if args.do_print:
+        cmd_print(m)
+        did = True
+    if args.test_map_pgs:
+        cmd_test_map_pgs(m, args.pool)
+        did = True
+    if args.upmap:
+        cmd_upmap(m, args.upmap, args.upmap_max)
+        did = True
+    if not did:
+        cmd_print(m)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,6 +119,26 @@ async def amain(args) -> int:
                 await img.write(off, data[off:off + step])
             await img.close()
             print(f"imported {len(data)} bytes into {args.image}")
+        elif args.cmd == "mirror":
+            from ..rbd.mirror import (
+                mirror_disable, mirror_enable, mirror_enabled,
+                mirror_status,
+            )
+            if args.mirror_cmd != "ls" and not args.image:
+                print(f"error: mirror {args.mirror_cmd} requires an "
+                      f"image name", file=sys.stderr)
+                return 2
+            if args.mirror_cmd == "enable":
+                await mirror_enable(io, args.image)
+                print(f"mirroring enabled for {args.image}")
+            elif args.mirror_cmd == "disable":
+                await mirror_disable(io, args.image)
+                print(f"mirroring disabled for {args.image}")
+            elif args.mirror_cmd == "ls":
+                for name in await mirror_enabled(io):
+                    print(name)
+            elif args.mirror_cmd == "status":
+                print(await mirror_status(io, args.image))
         elif args.cmd == "bench":
             img = await Image.open(io, args.image)
             size = await img.size()
@@ -174,6 +194,10 @@ def main(argv=None) -> int:
     sp = sub.add_parser("import")
     sp.add_argument("path"); sp.add_argument("image")
     sp.add_argument("--order", type=int, default=22)
+    sp = sub.add_parser("mirror")
+    sp.add_argument("mirror_cmd",
+                    choices=["enable", "disable", "ls", "status"])
+    sp.add_argument("image", nargs="?")
     sp = sub.add_parser("bench")
     sp.add_argument("image")
     sp.add_argument("--io-size", default="4K")

@@ -19,6 +19,7 @@ class AsyncReserver:
         self.granted: set = set()
         self._queue: list[tuple[int, int, object, asyncio.Future]] = []
         self._seq = 0
+        self._leases: dict = {}     # item -> monotonic expiry
 
     def _do_grants(self) -> None:
         while self._queue and len(self.granted) < self.max_allowed:
@@ -43,8 +44,42 @@ class AsyncReserver:
             self.cancel(item)
             raise
 
+    def get_or_fail(self, item, lease: float | None = None) -> bool:
+        """Immediate grant or False -- never queues (the remote-
+        reservation pattern: a busy peer answers 'rejected' and the
+        requester retries later rather than parking a slot).
+
+        ``lease`` bounds the grant's lifetime: a remote holder that
+        crashes (or whose release message is lost) must not leak the
+        slot forever -- with one slot that would wedge the feature
+        until restart.  Expired leases are purged lazily."""
+        import time
+        self._purge_leases()
+        if item in self.granted:
+            if lease is not None:
+                self._leases[item] = time.monotonic() + lease
+            return True
+        if len(self.granted) >= self.max_allowed:
+            return False
+        self.granted.add(item)
+        if lease is not None:
+            self._leases[item] = time.monotonic() + lease
+        return True
+
+    def _purge_leases(self) -> None:
+        import time
+        if not self._leases:
+            return
+        now = time.monotonic()
+        for item, expires in list(self._leases.items()):
+            if now >= expires:
+                del self._leases[item]
+                self.granted.discard(item)
+        self._do_grants()
+
     def release(self, item) -> None:
         self.granted.discard(item)
+        self._leases.pop(item, None)
         self._do_grants()
 
     def cancel(self, item) -> None:

@@ -91,6 +91,12 @@ class OSD:
             int(self.config["osd_max_backfills"]))
         self.remote_reserver = AsyncReserver(
             int(self.config["osd_max_backfills"]))
+        # scrub slots (osd_max_scrubs; separate from backfill so a
+        # recovering cluster can still scrub and vice versa)
+        self.scrub_reserver = AsyncReserver(
+            int(self.config.get("osd_max_scrubs", 1)))
+        self._scrub_stamps: dict[str, float] = {}
+        self._scrubbing: set[str] = set()
         self._sched_event = asyncio.Event()
         self._tid = itertools.count(1)
         self._waiters: dict[int, asyncio.Future] = {}
@@ -175,6 +181,19 @@ class OSD:
         async def perf_dump(req):
             return self.perf.dump()
 
+        async def scrub_cmd(req):
+            pgid = req.get("pgid")
+            if not pgid or pgid not in self.pgs:
+                return {"err": f"no such pg {pgid!r}"}
+            pg = self.pgs[pgid]
+            if not pg.is_primary():
+                return {"err": f"osd.{self.whoami} is not primary "
+                               f"for {pgid}"}
+            from .scrub import scrub_pg
+            res = await scrub_pg(pg, repair=bool(req.get("repair")))
+            self._scrub_stamps[pgid] = time.monotonic()
+            return res.to_dict()
+
         async def status(req):
             return {"whoami": self.whoami, "epoch": self.osdmap.epoch,
                     "num_pgs": len(self.pgs),
@@ -202,6 +221,7 @@ class OSD:
         sock.register("dump_ops_in_flight", "in-flight client ops",
                       ops_in_flight)
         sock.register("config show", "all config values", config_show)
+        sock.register("scrub", "scrub a pg: {pgid, repair}", scrub_cmd)
         sock.register("config get", "describe one option", config_get)
         sock.register("config set", "set option (name=..., value=...)",
                       config_set)
@@ -536,6 +556,7 @@ class OSD:
                 pg.kick_peering()
             if pg.state == "active" and pg.pool.removed_snaps:
                 pg.kick_snap_trim(pg.pool.removed_snaps)
+        self._maybe_schedule_scrubs(now)
         peers = [osd for osd, info in self.osdmap.osds.items()
                  if osd != self.whoami and info.up]
         await asyncio.gather(*(self._ping_one(o, now) for o in peers),
@@ -762,6 +783,99 @@ class OSD:
                                  "from_osd": self.whoami}))
 
     async def _h_ec_subop_write_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    # -- scrub scheduling (osd_scrub_sched.cc in miniature) -----------------
+    def _maybe_schedule_scrubs(self, now: float) -> None:
+        interval = float(self.config.get("osd_scrub_interval", 0))
+        if interval <= 0:       # scheduling off unless configured
+            return
+        for pgid, pg in self.pgs.items():
+            if (not pg.is_primary() or pg.state != "active"
+                    or pgid in self._scrubbing
+                    or pg._recovery_pending()):
+                continue
+            last = self._scrub_stamps.get(pgid, 0.0)
+            if now - last < interval:
+                continue
+            self._scrubbing.add(pgid)
+            self._track(asyncio.ensure_future(
+                self._run_scheduled_scrub(pgid)))
+
+    async def _run_scheduled_scrub(self, pgid: str) -> None:
+        """One reserved scrub: local slot + a slot on every acting
+        replica, then the scrub itself (repair on by default, the
+        osd_scrub_auto_repair discipline)."""
+        pg = self.pgs.get(pgid)
+        granted_remote: list[int] = []
+        got_local = False
+        try:
+            if pg is None or not pg.is_primary():
+                return
+            await self.scrub_reserver.request(pgid, timeout=30)
+            got_local = True
+            peers = [o for o in pg.acting_peers() if self.osd_is_up(o)]
+            for o in peers:
+                replies = await self.fanout_and_wait(
+                    [(o, "scrub_reserve", {"pgid": pgid}, [])],
+                    collect=True, timeout=10)
+                if not replies or not replies[0].data.get("granted"):
+                    return          # replica busy; retried next tick
+                granted_remote.append(o)
+            from .scrub import scrub_pg
+            res = await scrub_pg(pg, repair=bool(
+                self.config.get("osd_scrub_auto_repair", True)))
+            self._scrub_stamps[pgid] = time.monotonic()
+            self.perf_osd.inc("scrubs")
+            if not res.clean:
+                self.perf_osd.inc("scrub_repairs", len(res.repaired))
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass                    # retried next tick
+        finally:
+            if got_local:
+                self.scrub_reserver.release(pgid)
+            for o in granted_remote:
+                try:
+                    await self.fanout_and_wait(
+                        [(o, "scrub_release", {"pgid": pgid}, [])],
+                        collect=True, timeout=5)
+                except (ConnectionError, OSError,
+                        asyncio.TimeoutError):
+                    pass
+            self._scrubbing.discard(pgid)
+
+    async def _h_pg_scrub_map_req(self, conn, msg) -> None:
+        """Replica side of a scrub round: digest every local object
+        (scrub_backend.cc building the replica scrub map)."""
+        from .scrub import build_scrub_map
+        pg = self._get_pg(msg.data["pgid"])
+        smap = build_scrub_map(self.store, pg.coll) if pg else {}
+        await conn.send(Message("pg_scrub_map", {
+            "pgid": msg.data["pgid"], "map": smap,
+            "from_osd": self.whoami, "tid": msg.data.get("tid")}))
+
+    async def _h_pg_scrub_map(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    async def _h_scrub_reserve(self, conn, msg) -> None:
+        """Remote scrub slot (the scrubber's replica reservations --
+        a replica scrubs for at most osd_max_scrubs PGs at once)."""
+        granted = self.scrub_reserver.get_or_fail(
+            msg.data["pgid"], lease=120.0)
+        await conn.send(Message("scrub_reserve_reply", {
+            "pgid": msg.data["pgid"], "granted": granted,
+            "from_osd": self.whoami, "tid": msg.data.get("tid")}))
+
+    async def _h_scrub_reserve_reply(self, conn, msg) -> None:
+        self._resolve_tid(msg)
+
+    async def _h_scrub_release(self, conn, msg) -> None:
+        self.scrub_reserver.release(msg.data["pgid"])
+        await conn.send(Message("scrub_release_ack", {
+            "pgid": msg.data["pgid"], "from_osd": self.whoami,
+            "tid": msg.data.get("tid")}))
+
+    async def _h_scrub_release_ack(self, conn, msg) -> None:
         self._resolve_tid(msg)
 
     async def _h_ec_subop_read(self, conn, msg) -> None:

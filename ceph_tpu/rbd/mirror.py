@@ -57,7 +57,11 @@ def _mirror_snaps(img: Image) -> list[tuple[int, str]]:
     out = []
     for s in img.list_snaps():
         if s["name"].startswith(SNAP_PREFIX):
-            out.append((int(s["name"][len(SNAP_PREFIX):]), s["name"]))
+            try:
+                seq = int(s["name"][len(SNAP_PREFIX):])
+            except ValueError:
+                continue     # user snap that merely shares the prefix
+            out.append((seq, s["name"]))
     return sorted(out)
 
 
@@ -85,8 +89,10 @@ async def mirror_sync(src_ioctx, dst_ioctx, image_name: str) -> dict:
         prior = common
         seq = max((n for n, _ in common + orphans), default=0) + 1
         snap_name = f"{SNAP_PREFIX}{seq}"
-        # snapshot the PRIMARY (needs a writable handle for snap ops)
-        wsrc = await Image.open(src_ioctx, image_name)
+        # snapshot the PRIMARY through a snap-only handle: taking
+        # the exclusive lock would make in-use images unreplicable
+        # (EBUSY forever while a client holds the image open)
+        wsrc = await Image.open(src_ioctx, image_name, exclusive=False)
         try:
             for _, orphan in orphans:    # failed-sync leftovers
                 await wsrc.remove_snap(orphan)
@@ -108,6 +114,12 @@ async def mirror_sync(src_ioctx, dst_ioctx, image_name: str) -> dict:
                                  order=src.meta["order"])
                 dst = await Image.open(dst_ioctx, image_name)
             try:
+                if orphans and prior:
+                    # a previous sync died mid-copy: the secondary HEAD
+                    # may hold part of a delta that was never frozen;
+                    # rewind it to the last common snapshot so the
+                    # base-diff applies onto exactly-base content
+                    await dst.rollback_snap(prior[-1][1])
                 if await dst.size() != size:
                     await dst.resize(size)
                 base = prior[-1][1] if prior else None
@@ -144,7 +156,8 @@ async def mirror_sync(src_ioctx, dst_ioctx, image_name: str) -> dict:
                 await dst.close()
         finally:
             await src_snap.close()
-        wsrc = await Image.open(src_ioctx, image_name)
+        wsrc = await Image.open(src_ioctx, image_name,
+                                exclusive=False)
         try:
             for _, old in _mirror_snaps(wsrc)[:-SNAP_RETENTION]:
                 await wsrc.remove_snap(old)

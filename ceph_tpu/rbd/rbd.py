@@ -180,7 +180,13 @@ class Image:
     # -- open/close ---------------------------------------------------------
     @staticmethod
     async def open(ioctx, name: str, snapshot: str | None = None,
-                   read_only: bool = False) -> "Image":
+                   read_only: bool = False,
+                   exclusive: bool = True) -> "Image":
+        """``exclusive=False`` opens writable WITHOUT taking the image
+        lock -- for snapshot-only administrative handles (rbd-mirror
+        snapshots a live image without stealing the client's lock; the
+        header mutations are atomic cls ops).  Data writes through a
+        non-exclusive handle forgo single-writer protection."""
         try:
             iid = (await ioctx.exec(
                 RBD_DIRECTORY, "rbd", "dir_get_id",
@@ -199,7 +205,7 @@ class Image:
                     snap_id)
         if snapshot is not None:
             img.snap_id = img._snap_by_name(snapshot)["id"]
-        if not img.read_only:
+        if not img.read_only and exclusive:
             await img._acquire_lock()
         await img._refresh_snapc()
         return img

@@ -158,6 +158,10 @@ async def _run_daemon_command(sock_path: str, words: list[str]) -> int:
         words, kwargs = words[:2], {"name": words[2]}
     elif words[:2] == ["config", "set"] and len(words) >= 4:
         words, kwargs = words[:2], {"name": words[2], "value": words[3]}
+    elif words[:1] == ["scrub"] and len(words) >= 2:
+        kwargs = {"pgid": words[1],
+                  "repair": "repair" in words[2:]}
+        words = words[:1]
     try:
         result = await admin_command(sock_path, " ".join(words), **kwargs)
         print(json.dumps(result, indent=2, default=str))

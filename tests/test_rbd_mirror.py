@@ -109,6 +109,62 @@ def test_failed_sync_orphan_does_not_lose_delta():
     run(main())
 
 
+def test_mirror_live_image_and_interrupted_copy_rollback():
+    """An image held OPEN by a client must still replicate (snap-only
+    handles skip the exclusive lock), and an interrupted copy (dst
+    HEAD touched, never frozen) must roll back before the next delta."""
+    async def main():
+        site_a, site_b = await two_clusters()
+        mon_a, osds_a, ra, ia = site_a
+        mon_b, osds_b, rb, ib = site_b
+        rbd = RBD()
+        try:
+            await rbd.create(ia, "live", 2 * (1 << ORDER), order=ORDER)
+            holder = await Image.open(ia, "live")   # client holds lock
+            await holder.write(0, b"gen1")
+            out = await mirror_sync(ia, ib, "live")
+            assert out["snap"] == ".mirror.1"       # no EBUSY
+            # simulate a sync that died mid-copy: orphan primary snap
+            # + half-applied delta on the secondary HEAD
+            await holder.write(0, b"gen2")
+            snapper = await Image.open(ia, "live", exclusive=False)
+            await snapper.create_snap(".mirror.2")  # orphan
+            await snapper.close()
+            dirty = await Image.open(ib, "live")
+            await dirty.write(0, b"HALF")           # never frozen
+            await dirty.close()
+            # primary reverts the content: base-diff would see "no
+            # change" and freeze the stale HALF without the rollback
+            await holder.write(0, b"gen1")
+            out = await mirror_sync(ia, ib, "live")
+            d = await Image.open(ib, "live", read_only=True)
+            assert await d.read(0, 4) == b"gen1"
+            await d.close()
+            # a foreign snapshot sharing the prefix must not crash
+            s2 = await Image.open(ia, "live", exclusive=False)
+            await s2.create_snap(".mirror.pre-upgrade")
+            await s2.close()
+            await mirror_sync(ia, ib, "live")
+            await holder.close()
+        finally:
+            await teardown(mon_a, osds_a, ra)
+            await teardown(mon_b, osds_b, rb)
+    run(main())
+
+
+def test_scrub_reserver_lease_expires():
+    from ceph_tpu.common.reserver import AsyncReserver
+    import time
+
+    r = AsyncReserver(1)
+    assert r.get_or_fail("pgA", lease=0.05)
+    assert not r.get_or_fail("pgB", lease=0.05)   # slot busy
+    time.sleep(0.06)
+    # the crashed holder's lease lapsed: the slot frees itself
+    assert r.get_or_fail("pgB", lease=0.05)
+    r.release("pgB")
+
+
 def test_mirror_daemon_replays_enabled_images():
     async def main():
         site_a, site_b = await two_clusters()

@@ -32,7 +32,8 @@ class AsyncReserver:
     async def request(self, item, prio: int = 0,
                       timeout: float | None = None) -> None:
         """Wait for a slot.  Re-requesting a granted item is a no-op."""
-        if item in self.granted:
+        self._purge_leases()    # a crashed remote holder's expired
+        if item in self.granted:  # lease must not starve local waiters
             return
         fut = asyncio.get_event_loop().create_future()
         heapq.heappush(self._queue, (-prio, self._seq, item, fut))

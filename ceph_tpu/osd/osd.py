@@ -189,10 +189,23 @@ class OSD:
             if not pg.is_primary():
                 return {"err": f"osd.{self.whoami} is not primary "
                                f"for {pgid}"}
-            from .scrub import scrub_pg
-            res = await scrub_pg(pg, repair=bool(req.get("repair")))
-            self._scrub_stamps[pgid] = time.monotonic()
-            return res.to_dict()
+            if pgid in self._scrubbing:
+                return {"err": f"pg {pgid} already scrubbing"}
+            # operator scrubs obey the same slot budget as scheduled
+            # ones -- osd_max_scrubs must bound BOTH
+            self._scrubbing.add(pgid)
+            try:
+                await self.scrub_reserver.request(pgid, timeout=30)
+                from .scrub import scrub_pg
+                res = await scrub_pg(pg,
+                                     repair=bool(req.get("repair")))
+                self._scrub_stamps[pgid] = time.monotonic()
+                return res.to_dict()
+            except asyncio.TimeoutError:
+                return {"err": "scrub slots busy; try again"}
+            finally:
+                self.scrub_reserver.release(pgid)
+                self._scrubbing.discard(pgid)
 
         async def status(req):
             return {"whoami": self.whoami, "epoch": self.osdmap.epoch,
@@ -790,6 +803,8 @@ class OSD:
         interval = float(self.config.get("osd_scrub_interval", 0))
         if interval <= 0:       # scheduling off unless configured
             return
+        import random
+        due = []
         for pgid, pg in self.pgs.items():
             if (not pg.is_primary() or pg.state != "active"
                     or pgid in self._scrubbing
@@ -798,9 +813,16 @@ class OSD:
             last = self._scrub_stamps.get(pgid, 0.0)
             if now - last < interval:
                 continue
-            self._scrubbing.add(pgid)
-            self._track(asyncio.ensure_future(
-                self._run_scheduled_scrub(pgid)))
+            due.append(pgid)
+        if not due:
+            return
+        # ONE scrub kick per tick, randomly chosen: launching every due
+        # PG at once makes all primaries collide on the replicas'
+        # single scrub slots in lockstep, tick after tick
+        pgid = random.choice(due)
+        self._scrubbing.add(pgid)
+        self._track(asyncio.ensure_future(
+            self._run_scheduled_scrub(pgid)))
 
     async def _run_scheduled_scrub(self, pgid: str) -> None:
         """One reserved scrub: local slot + a slot on every acting
@@ -849,7 +871,7 @@ class OSD:
         (scrub_backend.cc building the replica scrub map)."""
         from .scrub import build_scrub_map
         pg = self._get_pg(msg.data["pgid"])
-        smap = build_scrub_map(self.store, pg.coll) if pg else {}
+        smap = await build_scrub_map(self.store, pg.coll) if pg else {}
         await conn.send(Message("pg_scrub_map", {
             "pgid": msg.data["pgid"], "map": smap,
             "from_osd": self.whoami, "tid": msg.data.get("tid")}))

@@ -20,10 +20,18 @@ from ..native import crc32c
 from .backend import META_OID, ECBackend, SIZE_XATTR
 
 
-def build_scrub_map(store, coll: str, deep: bool = True) -> dict[str, dict]:
-    """Digest every object in a PG collection (replica side)."""
+async def build_scrub_map(store, coll: str,
+                          deep: bool = True) -> dict[str, dict]:
+    """Digest every object in a PG collection (replica side).
+
+    Async with periodic yields: digesting a whole PG synchronously
+    would stall the event loop past the heartbeat grace and get the
+    daemon falsely reported down."""
+    import asyncio
     out: dict[str, dict] = {}
-    for oid in store.list_objects(coll):
+    for i, oid in enumerate(store.list_objects(coll)):
+        if i % 16 == 15:
+            await asyncio.sleep(0)
         if oid == META_OID:
             continue
         st = store.stat(coll, oid)
@@ -67,7 +75,7 @@ class ScrubResult:
 async def scrub_replicated(pg, repair: bool = False) -> ScrubResult:
     """Compare scrub maps across replicas; majority is authoritative."""
     res = ScrubResult(pg.pgid)
-    local = build_scrub_map(pg.osd.store, pg.coll)
+    local = await build_scrub_map(pg.osd.store, pg.coll)
     maps: dict[int, dict[str, dict]] = {pg.whoami: local}
     peers = [o for o in pg.acting_peers() if pg.osd.osd_is_up(o)]
     replies = await pg.osd.fanout_and_wait(

@@ -173,6 +173,7 @@ class Image:
         self.read_only = read_only
         self.snap_id = snap_id
         self._cookie = os.urandom(4).hex()
+        self._watch_cookie = None
         self._renew_task: asyncio.Task | None = None
         self._parent: Image | None = None
         self._closed = False
@@ -207,8 +208,30 @@ class Image:
             img.snap_id = img._snap_by_name(snapshot)["id"]
         if not img.read_only and exclusive:
             await img._acquire_lock()
+            # header watch (librbd's ImageWatcher): another client's
+            # snap/resize refreshes OUR snap context before their op
+            # completes -- writing with a stale snapc would skip the
+            # COW that keeps the new snapshot frozen
+            img._watch_cookie = await img.ioctx.watch(
+                _header(img.id), img._on_header_notify)
         await img._refresh_snapc()
         return img
+
+    async def _on_header_notify(self, payload: bytes) -> None:
+        try:
+            await self._refresh_meta()
+            await self._refresh_snapc()
+        except RadosError:
+            pass                   # next header op retries the refresh
+
+    async def _notify_header(self) -> None:
+        """Tell every open handle the header changed (snap created/
+        removed, resized); waits for their refresh acks."""
+        try:
+            await self.ioctx.notify(_header(self.id), b"header-update",
+                                    timeout=5.0)
+        except RadosError:
+            pass                   # no watchers / transient: best effort
 
     async def close(self) -> None:
         if self._closed:
@@ -219,6 +242,12 @@ class Image:
             try:
                 await self._renew_task
             except asyncio.CancelledError:
+                pass
+        if getattr(self, "_watch_cookie", None) is not None:
+            try:
+                await self.ioctx.unwatch(_header(self.id),
+                                         self._watch_cookie)
+            except RadosError:
                 pass
         if not self.read_only:
             try:
@@ -508,6 +537,7 @@ class Image:
         await self.ioctx.exec(_header(self.id), "rbd", "set_size",
                               json.dumps({"size": new_size}).encode())
         await self._refresh_meta()
+        await self._notify_header()
 
     # -- snapshots -----------------------------------------------------------
     async def create_snap(self, snap_name: str) -> int:
@@ -524,6 +554,7 @@ class Image:
             raise _wrap(e) from e
         await self._refresh_meta()
         await self._refresh_snapc()
+        await self._notify_header()
         return sid
 
     async def remove_snap(self, snap_name: str) -> None:
@@ -545,6 +576,7 @@ class Image:
         await self.ioctx.selfmanaged_snap_remove(snap["id"])
         await self._refresh_meta()
         await self._refresh_snapc()
+        await self._notify_header()
 
     async def protect_snap(self, snap_name: str) -> None:
         snap = self._snap_by_name(snap_name)

@@ -172,6 +172,35 @@ def test_two_images_shared_ioctx_snapc_isolated():
     run(main())
 
 
+def test_header_watch_refreshes_holder_snapc():
+    """A snapshot created by ANOTHER handle (rbd-mirror's snap-only
+    open) must refresh the lock holder's snap context via the header
+    watch before the snap op completes -- otherwise the holder's next
+    write skips COW and silently mutates the 'frozen' snapshot."""
+    async def main():
+        mon, osds, rados, io = await cluster_io()
+        rbd = RBD()
+        try:
+            await rbd.create(io, "img", 1 << ORDER, order=ORDER)
+            holder = await Image.open(io, "img")    # exclusive client
+            await holder.write(0, b"frozen-gen")
+            # an administrative snap-only handle snapshots the image
+            admin = await Image.open(io, "img", exclusive=False)
+            await admin.create_snap("pit")
+            await admin.close()
+            # the HOLDER writes next -- with a refreshed snapc this
+            # COWs; with a stale one it would corrupt the snapshot
+            await holder.write(0, b"newer-data")
+            snap = await Image.open(io, "img", snapshot="pit")
+            assert await snap.read(0, 10) == b"frozen-gen"
+            await snap.close()
+            assert await holder.read(0, 10) == b"newer-data"
+            await holder.close()
+        finally:
+            await teardown(mon, osds, rados)
+    run(main())
+
+
 def test_exclusive_lock():
     async def main():
         mon, osds, rados, io = await cluster_io()

@@ -28,12 +28,29 @@ class Compressor:
     def compress(self, data: bytes) -> bytes:
         return self._compress(bytes(data))
 
-    def decompress(self, data: bytes) -> bytes:
+    def decompress(self, data: bytes,
+                   max_length: int | None = None) -> bytes:
+        """``max_length`` bounds the materialized output: a crafted
+        frame claiming a small raw size must fail BEFORE expanding to
+        gigabytes (decompression bomb), not after."""
         try:
-            return self._decompress(bytes(data))
+            if max_length is None:
+                return self._decompress(bytes(data))
+            out = self._decompress_bounded(bytes(data), max_length + 1)
+        except CompressorError:
+            raise
         except Exception as e:
             raise CompressorError(
                 f"{self.name}: corrupt input: {e}") from e
+        if len(out) > max_length:
+            raise CompressorError(
+                f"{self.name}: output exceeds declared size "
+                f"{max_length}")
+        return out
+
+    def _decompress_bounded(self, data: bytes, cap: int) -> bytes:
+        """Incremental decompress producing at most ``cap`` bytes."""
+        raise NotImplementedError
 
     @staticmethod
     def create(name: str, **kw) -> "Compressor":
@@ -64,6 +81,9 @@ class ZlibCompressor(Compressor):
     def _decompress(self, data: bytes) -> bytes:
         return zlib.decompress(data)
 
+    def _decompress_bounded(self, data: bytes, cap: int) -> bytes:
+        return zlib.decompressobj().decompress(data, cap)
+
 
 try:
     import zstandard as _zstandard
@@ -87,6 +107,21 @@ class ZstdCompressor(Compressor):
     def _decompress(self, data: bytes) -> bytes:
         return self._d.decompress(data)
 
+    def _decompress_bounded(self, data: bytes, cap: int) -> bytes:
+        # max_output_size is IGNORED when the frame header embeds a
+        # content size (attacker-controlled), so the one-shot API can
+        # still materialize a bomb; the stream reader honors the read
+        # bound unconditionally
+        import io
+        out = bytearray()
+        with self._d.stream_reader(io.BytesIO(data)) as r:
+            while len(out) < cap:
+                chunk = r.read(cap - len(out))
+                if not chunk:
+                    break
+                out += chunk
+        return bytes(out)
+
 
 class LzmaCompressor(Compressor):
     name = "lzma"
@@ -100,6 +135,9 @@ class LzmaCompressor(Compressor):
     def _decompress(self, data: bytes) -> bytes:
         return lzma.decompress(data)
 
+    def _decompress_bounded(self, data: bytes, cap: int) -> bytes:
+        return lzma.LZMADecompressor().decompress(data, cap)
+
 
 class Bz2Compressor(Compressor):
     name = "bz2"
@@ -112,6 +150,9 @@ class Bz2Compressor(Compressor):
 
     def _decompress(self, data: bytes) -> bytes:
         return bz2.decompress(data)
+
+    def _decompress_bounded(self, data: bytes, cap: int) -> bytes:
+        return bz2.BZ2Decompressor().decompress(data, cap)
 
 
 _PLUGINS = {c.name: c for c in (ZlibCompressor, LzmaCompressor,

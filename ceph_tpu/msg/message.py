@@ -107,7 +107,10 @@ def unwrap_frame(buf: bytes, compressor=None) -> bytes:
         if compressor is None:
             raise ValueError("compressed frame on a plain connection")
         try:
-            out = compressor.decompress(buf[12:12 + comp_len])
+            # bounded: output capped at the declared raw_len so a
+            # bomb frame fails before materializing, not after
+            out = compressor.decompress(buf[12:12 + comp_len],
+                                        max_length=raw_len)
         except Exception as e:
             # corrupt input must look like any other framing error so
             # the read loop's reconnect/teardown path handles it

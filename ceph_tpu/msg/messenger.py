@@ -88,16 +88,35 @@ class Connection:
     async def send(self, msg: Message) -> None:
         if msg.type == ACK_TYPE:
             raise ValueError(f"{ACK_TYPE} is a reserved control frame type")
-        async with self._send_lock:
-            # window check INSIDE the lock: senders queued on the lock
-            # must re-check, or K concurrent sends overshoot the window
-            # by K-1.  Acks reopen from the read loop (no send lock), so
-            # waiting here cannot deadlock.
-            while self._window_full() and not self.closed:
-                self._window_open.clear()
-                await self._window_open.wait()
+        while True:
+            # window wait OUTSIDE the lock: _reconnect needs _send_lock
+            # for the writer swap+replay, and the acks that reopen the
+            # window need the reconnected stream -- a sender parked
+            # here while holding the lock would deadlock the pair.
+            st = await self._send_locked(msg)
+            if st == "sent":
+                return
+            if st == "reconnect":
+                # outside the send lock: _reconnect takes it for the
+                # writer swap + replay, so the replayed frames cannot
+                # interleave with other senders' writes
+                await self.messenger._reconnect(self)
+                return          # msg is in unacked; the replay sent it
+            self._window_open.clear()
+            await self._window_open.wait()
             if self.closed:
                 raise ConnectionError(f"{self.peer_name} closed")
+
+    async def _send_locked(self, msg: Message) -> str:
+        """One locked send attempt: "sent" | "reconnect" | "window"
+        ("window" = flow-control window full, caller waits UNLOCKED
+        and retries -- K queued senders re-check here so they cannot
+        overshoot the window by K-1)."""
+        async with self._send_lock:
+            if self.closed:
+                raise ConnectionError(f"{self.peer_name} closed")
+            if self._window_full():
+                return "window"
             self.out_seq += 1
             msg.seq = self.out_seq
             msg.from_name = self.messenger.name
@@ -109,18 +128,13 @@ class Connection:
                     and len(buf) > OFFLOAD_THRESHOLD:
                 # multi-MB compress/encrypt off the event loop so
                 # heartbeat handling doesn't stall behind it; ordering
-                # is preserved -- we still hold the send lock.  The
-                # await opens a window where a RECONNECT can swap the
-                # writer and renegotiate keys: snapshot the generation
-                # and, if it moved, skip the write -- the message is
-                # already in unacked and _resend_unacked will re-wrap
-                # it with the NEW transforms.
-                gen = self.generation
+                # is preserved -- we still hold the send lock, and a
+                # reconnect cannot swap the writer or renegotiate keys
+                # under us because its swap+replay also requires the
+                # send lock.
                 wire = await asyncio.get_event_loop().run_in_executor(
                     None, wrap_frame, buf, self.compressor,
                     self.aead_tx)
-                if self.generation != gen:
-                    return
                 if self.closed:
                     raise ConnectionError(f"{self.peer_name} closed")
             else:
@@ -134,12 +148,12 @@ class Connection:
             try:
                 self.writer.write(wire)
                 await self.writer.drain()
+                return "sent"
             except (ConnectionError, OSError):
-                if self.outgoing:
-                    await self.messenger._reconnect(self)
-                else:
+                if not self.outgoing:
                     await self.close()
                     raise
+                return "reconnect"
 
     def _note_delivered(self, nbytes: int) -> None:
         """Receive side: count a delivery toward the ack cadence and
@@ -478,18 +492,25 @@ class Messenger:
                         conn.peer_addr[0], conn.peer_addr[1])
                     last_seq, nego, hs_nonce, hs_cnonce = \
                         await self._handshake_client(reader, writer)
-                    self._apply_negotiation(conn, nego, hs_nonce,
-                                            hs_cnonce, is_server=False)
-                    conn._trim_acked(last_seq)
-                    conn.reader, conn.writer = reader, writer
-                    # server->client stream restarts on the new accept
-                    conn.in_seq = 0
-                    conn.generation += 1
-                    if conn._read_task:
-                        conn._read_task.cancel()
-                    conn._read_task = asyncio.ensure_future(
-                        self._read_loop(conn))
-                    await conn._resend_unacked()
+                    # swap + replay under the SEND lock: a sender mid-
+                    # flight must not write a newer seq onto the fresh
+                    # stream before the replay of older unacked frames
+                    # (the receiver's dedup would then drop the older
+                    # seq as a replay -> silent loss)
+                    async with conn._send_lock:
+                        self._apply_negotiation(conn, nego, hs_nonce,
+                                                hs_cnonce,
+                                                is_server=False)
+                        conn._trim_acked(last_seq)
+                        conn.reader, conn.writer = reader, writer
+                        # server->client stream restarts on new accept
+                        conn.in_seq = 0
+                        conn.generation += 1
+                        if conn._read_task:
+                            conn._read_task.cancel()
+                        conn._read_task = asyncio.ensure_future(
+                            self._read_loop(conn))
+                        await conn._resend_unacked()
                     return
                 except (ConnectionError, OSError):
                     await asyncio.sleep(0.05 * (2 ** attempt))

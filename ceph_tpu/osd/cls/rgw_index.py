@@ -40,27 +40,35 @@ def complete(hctx, indata: bytes) -> bytes:
             hctx.map_remove_key(_PENDING + tag)
         except ClsError:
             raise ClsError("ECANCELED", "no pending op for tag")
+    # the REPLACED entry is returned so the gateway can reclaim its
+    # backing data: purging by a client-side pre-read races a
+    # concurrent PUT (two writers each pre-read the same old entry and
+    # the losing generation's data leaks); the swap must be decided by
+    # the atomic op itself (cls_rgw.cc returns the existing dir entry
+    # to the completing gateway for the same reason)
+    try:
+        replaced = hctx.map_get_val(_ENTRY + q["key"])
+    except ClsError:
+        replaced = b""
     if q.get("op") == "del":
-        try:
-            hctx.map_get_val(_ENTRY + q["key"])
-        except ClsError:
+        if not replaced:
             raise ClsError("ENOENT", q["key"])
         hctx.map_remove_key(_ENTRY + q["key"])
     else:
         hctx.map_set_val(_ENTRY + q["key"],
                          json.dumps(q["entry"]).encode())
-    return b""
+    return replaced
 
 
 @register("rgw_index", "unlink", CLS_METHOD_RD | CLS_METHOD_WR)
 def unlink(hctx, indata: bytes) -> bytes:
     q = json.loads(indata)
     try:
-        hctx.map_get_val(_ENTRY + q["key"])
+        removed = hctx.map_get_val(_ENTRY + q["key"])
     except ClsError:
         raise ClsError("ENOENT", q["key"])
     hctx.map_remove_key(_ENTRY + q["key"])
-    return b""
+    return removed          # caller reclaims exactly what was unlinked
 
 
 @register("rgw_index", "get", CLS_METHOD_RD)

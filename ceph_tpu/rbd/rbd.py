@@ -177,6 +177,7 @@ class Image:
         self._renew_task: asyncio.Task | None = None
         self._parent: Image | None = None
         self._closed = False
+        self._fenced = False
 
     # -- open/close ---------------------------------------------------------
     @staticmethod
@@ -276,18 +277,38 @@ class Image:
                            "image is locked by another client") from e
         self._renew_task = asyncio.ensure_future(self._renew_loop())
 
+    def _writable_or_raise(self) -> None:
+        if self.read_only:
+            raise RbdError("EROFS")
+        if self._fenced:
+            raise RbdError("EBLOCKLISTED",
+                           "exclusive lock lost; handle is fenced")
+
+    async def _renew_once(self) -> None:
+        try:
+            await self.ioctx.exec(
+                _header(self.id), "lock", "lock", json.dumps({
+                    "name": LOCK_NAME, "type": "exclusive",
+                    "cookie": self._cookie,
+                    "duration": LOCK_DURATION_S,
+                    "flags": 1}).encode())
+        except RadosError as e:
+            # EBUSY: our lease expired and ANOTHER client holds the
+            # lock; ENOENT: the lock/header vanished.  Either way we
+            # are no longer the single writer -- fence the handle so
+            # no further data write can race the new owner (librbd
+            # pairs lock loss with an OSD blocklist of the old client;
+            # ManagedLock.cc / image_watcher).
+            if e.errno_name in ("EBUSY", "ENOENT"):
+                self._fenced = True
+            # other errors (transient): retried next period
+        except (ConnectionError, OSError):
+            pass                      # retried next period; expiry wins
+
     async def _renew_loop(self) -> None:
-        while True:
+        while not self._fenced:
             await asyncio.sleep(LOCK_RENEW_S)
-            try:
-                await self.ioctx.exec(
-                    _header(self.id), "lock", "lock", json.dumps({
-                        "name": LOCK_NAME, "type": "exclusive",
-                        "cookie": self._cookie,
-                        "duration": LOCK_DURATION_S,
-                        "flags": 1}).encode())
-            except (RadosError, ConnectionError, OSError):
-                pass                  # retried next period; expiry wins
+            await self._renew_once()
 
     @staticmethod
     async def break_lock(ioctx, name: str) -> None:
@@ -440,8 +461,7 @@ class Image:
                 raise _wrap(e) from e
 
     async def write(self, off: int, data: bytes) -> int:
-        if self.read_only:
-            raise RbdError("EROFS")
+        self._writable_or_raise()
         size = self.meta["size"]
         if off + len(data) > size:
             raise RbdError("EINVAL", "write past end of image")
@@ -475,8 +495,7 @@ class Image:
     async def discard(self, off: int, length: int) -> None:
         """Deallocate a range: whole objects are removed, partial
         ranges zeroed (ImageRequest discard)."""
-        if self.read_only:
-            raise RbdError("EROFS")
+        self._writable_or_raise()
         lay = self._layout
         has_parent = bool(self.meta.get("parent"))
 
@@ -516,8 +535,7 @@ class Image:
 
     # -- resize -------------------------------------------------------------
     async def resize(self, new_size: int) -> None:
-        if self.read_only:
-            raise RbdError("EROFS")
+        self._writable_or_raise()
         old = self.meta["size"]
         if new_size < old:
             lay = self._layout
@@ -541,8 +559,7 @@ class Image:
 
     # -- snapshots -----------------------------------------------------------
     async def create_snap(self, snap_name: str) -> int:
-        if self.read_only:
-            raise RbdError("EROFS")
+        self._writable_or_raise()
         sid = await self.ioctx.selfmanaged_snap_create()
         try:
             await self.ioctx.exec(
@@ -558,8 +575,7 @@ class Image:
         return sid
 
     async def remove_snap(self, snap_name: str) -> None:
-        if self.read_only:
-            raise RbdError("EROFS")
+        self._writable_or_raise()
         snap = self._snap_by_name(snap_name)
         kids = json.loads(await self.ioctx.exec(
             RBD_CHILDREN, "rbd", "list_children", json.dumps({
@@ -604,8 +620,7 @@ class Image:
     async def rollback_snap(self, snap_name: str) -> None:
         """Rewrite head data from the snapshot (Operations::snap_rollback).
         Object-by-object copy of the snap content over the head."""
-        if self.read_only:
-            raise RbdError("EROFS")
+        self._writable_or_raise()
         snap = self._snap_by_name(snap_name)
         lay = self._layout
         await self.resize(snap["size"])
@@ -629,8 +644,7 @@ class Image:
     async def flatten(self) -> None:
         """Copy all parent data up, then sever the parent link
         (librbd::Operations::flatten)."""
-        if self.read_only:
-            raise RbdError("EROFS")
+        self._writable_or_raise()
         pref = self.meta.get("parent")
         if pref is None:
             raise RbdError("EINVAL", "image has no parent")

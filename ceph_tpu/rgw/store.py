@@ -126,8 +126,14 @@ class RgwStore:
     def _index(self, bucket: dict) -> str:
         return f"bucket_index.{bucket['id']}"
 
-    def _data_oid(self, bucket: dict, key: str) -> str:
-        return f"{bucket['id']}__shadow_{key}"
+    def _data_oid(self, bucket: dict, key: str,
+                  tag: str = "") -> str:
+        # tagged oids give overwrite PUTs a fresh generation: the old
+        # generation stays readable until the index flips (rgw keeps
+        # old head/tail objects alive until the index transaction
+        # lands, then GCs them -- rgw_rados.cc write path)
+        base = f"{bucket['id']}__shadow_{key}"
+        return f"{base}.{tag}" if tag else base
 
     def _part_oid(self, bucket: dict, key: str, upload_id: str,
                   part: int) -> str:
@@ -142,13 +148,8 @@ class RgwStore:
         if entry and "manifest" in entry:
             for part in entry["manifest"]:
                 await self.striper.remove(part["oid"])
-        await self.striper.remove(self._data_oid(bucket, key))
-
-    async def _old_entry(self, bucket_name: str, key: str) -> dict | None:
-        try:
-            return await self.get_entry(bucket_name, key)
-        except RgwError:
-            return None
+        oid = (entry or {}).get("data_oid") or self._data_oid(bucket, key)
+        await self.striper.remove(oid)
 
     async def put_object(self, bucket_name: str, key: str, data: bytes,
                          owner: str = "", content_type: str = "",
@@ -158,20 +159,46 @@ class RgwStore:
         idx = self._index(bucket)
         await self.ioctx.exec(idx, "rgw_index", "prepare", json.dumps(
             {"tag": tag, "key": key, "op": "put"}).encode())
-        soid = self._data_oid(bucket, key)
-        # replace semantics: the old entry's data (incl. multipart
-        # manifest parts) dies with the overwrite
-        await self._purge_data(bucket, key,
-                               await self._old_entry(bucket_name, key))
-        if data:
-            await self.striper.write(soid, data, 0)
-        etag = hashlib.md5(data).hexdigest()
-        entry = {"size": len(data), "etag": etag, "mtime": _now_iso(),
-                 "owner": owner, "content_type": content_type,
-                 "meta": meta or {}}
-        await self.ioctx.exec(idx, "rgw_index", "complete", json.dumps(
-            {"tag": tag, "key": key, "entry": entry}).encode())
+        # atomic replace: the new generation lands under a fresh tagged
+        # oid while the old one stays live; the index 'complete' is the
+        # commit point, RETURNS the entry it displaced (decided inside
+        # the atomic op -- a client-side pre-read races a concurrent
+        # PUT), and only then is that displaced data reclaimed.  A
+        # crash mid-PUT leaves the old object intact (the orphan new
+        # tag is garbage, never reachable).
+        soid = self._data_oid(bucket, key, tag)
+        try:
+            if data:
+                await self.striper.write(soid, data, 0)
+            etag = hashlib.md5(data).hexdigest()
+            entry = {"size": len(data), "etag": etag, "mtime": _now_iso(),
+                     "owner": owner, "content_type": content_type,
+                     "data_oid": soid, "meta": meta or {}}
+            raw = await self.ioctx.exec(
+                idx, "rgw_index", "complete",
+                json.dumps({"tag": tag, "key": key,
+                            "entry": entry}).encode())
+        except Exception:
+            try:                      # best-effort: the original error
+                await self.striper.remove(soid)   # must survive
+            except Exception:
+                pass
+            raise
+        await self._purge_replaced(bucket, key, raw, soid)
         return entry
+
+    async def _purge_replaced(self, bucket: dict, key: str,
+                              raw: bytes, new_oid: str) -> None:
+        """Reclaim the entry the index swap displaced (never the one
+        just linked: a same-oid no-op guard keeps a legacy undiffer-
+        entiated overwrite from deleting its own data)."""
+        if not raw:
+            return
+        old = json.loads(raw)
+        old_oid = old.get("data_oid") or self._data_oid(bucket, key)
+        if old_oid == new_oid:
+            return
+        await self._purge_data(bucket, key, old)
 
     async def put_object_manifest(self, bucket_name: str, key: str,
                                   parts: list[dict], owner: str,
@@ -179,18 +206,18 @@ class RgwStore:
                                   meta: dict | None = None) -> dict:
         """Link a multipart manifest as the object (complete-upload)."""
         bucket = await self.get_bucket(bucket_name)
-        old = await self._old_entry(bucket_name, key)
-        if old is not None:
-            await self._purge_data(bucket, key, old)
         size = sum(p["size"] for p in parts)
         entry = {"size": size, "etag": etag, "mtime": _now_iso(),
                  "owner": owner, "content_type": content_type,
                  "meta": meta or {},
                  "manifest": [{"oid": p["oid"], "size": p["size"]}
                               for p in parts]}
-        await self.ioctx.exec(
+        # index flip first; the swap's displaced entry (returned by
+        # the atomic op) is reclaimed only after commit
+        raw = await self.ioctx.exec(
             self._index(bucket), "rgw_index", "complete",
             json.dumps({"key": key, "entry": entry}).encode())
+        await self._purge_replaced(bucket, key, raw, "")
         return entry
 
     async def get_entry(self, bucket_name: str, key: str) -> dict:
@@ -215,8 +242,8 @@ class RgwStore:
             data = await self._read_manifest(entry["manifest"], off,
                                              length)
         else:
-            data = await self.striper.read(
-                self._data_oid(bucket, key), length, off)
+            oid = entry.get("data_oid") or self._data_oid(bucket, key)
+            data = await self.striper.read(oid, length, off)
         return entry, data
 
     async def _read_manifest(self, manifest: list[dict], off: int,
@@ -237,13 +264,17 @@ class RgwStore:
     async def delete_object(self, bucket_name: str, key: str) -> None:
         bucket = await self.get_bucket(bucket_name)
         try:
-            entry = await self.get_entry(bucket_name, key)
-        except RgwError:
-            return                        # S3 DELETE is idempotent
-        await self.ioctx.exec(
-            self._index(bucket), "rgw_index", "unlink",
-            json.dumps({"key": key}).encode())
-        await self._purge_data(bucket, key, entry)
+            raw = await self.ioctx.exec(
+                self._index(bucket), "rgw_index", "unlink",
+                json.dumps({"key": key}).encode())
+        except RadosError as e:
+            if e.errno_name == "ENOENT":
+                return                    # S3 DELETE is idempotent
+            raise
+        # purge exactly what the atomic unlink removed: two racing
+        # deletes cannot double-free, and a racing PUT's fresh
+        # generation is never touched
+        await self._purge_replaced(bucket, key, raw, "")
 
     async def list_objects(self, bucket_name: str, prefix: str = "",
                            marker: str = "", max_keys: int = 1000,

@@ -1,25 +1,37 @@
-"""Round benchmark: RS(k=8,m=3) erasure encode+decode throughput on TPU.
+"""Round benchmark: erasure-code throughput on TPU vs the CPU baseline.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Headline config (BASELINE.md): RS k=8 m=3, 1 MiB stripes, batch=1024,
-single chip, device-resident stripe batches (the deployment shape: stripes
-stream through HBM, thousands per launch).  Byte parity vs the host oracle
-is asserted before timing -- a number without parity is meaningless.
+Headline config (BASELINE.md): RS k=8 m=3, 1 MiB stripes, device-
+resident stripe batches, single chip, encode+decode combined
+(harmonic).  Byte parity vs the host oracle is asserted before timing
+-- a number without parity is meaningless.
 
-vs_baseline is measured against this repo's native C++ AVX2 encoder
-(native/gf8.cc, the ISA-L-technique split-nibble SIMD path, single
-thread), the same role ISA-L plays in the reference's
-ceph_erasure_code_benchmark CPU runs
-(src/test/erasure-code/ceph_erasure_code_benchmark.cc:155-193).
+Secondary configs (each its own entry under "configs"):
+  * cauchy_k10m4_decode: Cauchy k=10,m=4, 2-erasure decode (the
+    matrix-inverse path), 1 MiB stripes.
+  * rs_k8m3_4k_marshal: RS k=8,m=3 on 4 KiB chunks INCLUDING the
+    host->device upload -- the marshaling-bound regime the reference's
+    ISA-L benchmark runs in (SURVEY hard part d).
+  * crush_10m: 10M PG->OSD straw2 mappings over a 1000-OSD map
+    (vectorized placement; value in M mappings/s).
 
-Harness discipline (round-2 fixes):
-  * stripe batches are GENERATED ON DEVICE (jax.random) and stay resident
-    in HBM -- no per-iteration host->device upload; this is the deployment
-    shape where stripes stream through HBM between pipeline stages;
+vs_baseline is the repo's own native C++ AVX2 encoder (native/gf8.cc,
+ISA-L's split-nibble SIMD technique, single thread) -- stated plainly:
+this is an ISA-L-technique reimplementation, not a linked ISA-L build
+(none exists in this image).  Role analog:
+src/test/erasure-code/ceph_erasure_code_benchmark.cc:155-193.
+
+Harness discipline:
+  * stripe batches are GENERATED ON DEVICE and stay resident in HBM
+    (the deployment shape) except the 4k marshaling config, which
+    deliberately times the upload;
   * progress lines go to stderr immediately at every phase;
-  * an internal deadline (BENCH_DEADLINE_S, default 270s) triggers batch
-    back-off instead of a silent timeout; the JSON line ALWAYS prints.
+  * the TPU backend probe RETRIES in a loop until the deadline margin
+    (a transient tunnel outage must not zero a round -- round 3 was
+    lost to a single 90s probe window);
+  * an internal deadline (BENCH_DEADLINE_S, default 270s) triggers
+    batch back-off; the JSON line ALWAYS prints.
 """
 
 import json
@@ -78,7 +90,7 @@ def _watchdog(deadline: float) -> None:
     os._exit(4)
 
 
-def _backend_reachable(timeout: float = 90.0) -> bool:
+def _probe_once(timeout: float) -> bool:
     """Probe jax backend init in a CHILD process: if the TPU tunnel is
     dead the init blocks uninterruptibly, and only a process boundary
     lets us time it out."""
@@ -89,6 +101,22 @@ def _backend_reachable(timeout: float = 90.0) -> bool:
         return b"up" in res.stdout
     except (subprocess.TimeoutExpired, OSError):
         return False
+
+
+def _backend_reachable(deadline: float) -> bool:
+    """Retry the probe until ~deadline: a tunnel outage is usually
+    transient contention; one fixed 90s window lost round 3."""
+    attempt = 0
+    while True:
+        budget = deadline - time.monotonic() - 45
+        if budget < 15:
+            return False
+        attempt += 1
+        log(f"backend probe attempt {attempt} "
+            f"(window {min(75.0, budget):.0f}s)")
+        if _probe_once(min(75.0, budget)):
+            return True
+        time.sleep(min(20, max(0, deadline - time.monotonic() - 60)))
 
 
 def _device_batch(rng, batch, k, chunk):
@@ -112,7 +140,7 @@ def _device_batch(rng, batch, k, chunk):
 
 
 def _time_launches(fn, block, deadline, min_iters=3, max_iters=12):
-    """Median-free simple timing: async dispatch loop, block at the end."""
+    """Simple timing: async dispatch loop, block at the end."""
     out = fn()
     block(out)                      # warm / compile
     t1 = time.perf_counter()
@@ -128,57 +156,31 @@ def _time_launches(fn, block, deadline, min_iters=3, max_iters=12):
     return (time.perf_counter() - t0) / iters, iters, out
 
 
-def main() -> int:
-    k, m = 8, 3
-    stripe = 1 << 20                    # 1 MiB stripe
-    chunk = stripe // k                 # 128 KiB per chunk
-    batch = int(os.environ.get("BENCH_BATCH", "512"))
-    batch = max(8, (batch // 8) * 8)    # _device_batch tiles 8-stripe seeds
-    deadline = T0 + float(os.environ.get("BENCH_DEADLINE_S", "270"))
-    signal.signal(signal.SIGALRM, _alarm)
-    signal.alarm(int(deadline - T0 + 60))
-    threading.Thread(target=_watchdog, args=(deadline,),
-                     daemon=True).start()
-
-    log(f"start: k={k} m={m} stripe={stripe} batch={batch}")
-    log("probing backend reachability (child process)")
-    probe_budget = min(90.0, max(20.0, deadline - time.monotonic() - 60))
-    if not _backend_reachable(probe_budget):
-        # one retry: transient tunnel contention resolves in minutes
-        log("backend probe failed; retrying once")
-        time.sleep(min(30, max(0, deadline - time.monotonic() - 90)))
-        if not _backend_reachable(probe_budget):
-            RESULT["error"] = "tpu backend unreachable (tunnel down)"
-            emit()
-            return 1
-    log("backend probe ok")
+def _headline(rng, deadline):
     from ceph_tpu.gf import gen_rs_matrix, gf_matmul
-    from ceph_tpu.native import gf8_matmul
     from ceph_tpu.ec import registry
-    import jax
     import jax.numpy as jnp
 
-    log(f"jax backend={jax.default_backend()} devices={jax.devices()}")
+    k, m = 8, 3
+    stripe = 1 << 20
+    chunk = stripe // k
+    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    batch = max(8, (batch // 8) * 8)
     gen = gen_rs_matrix(k + m, k)
     codec = registry().factory("tpu", {"k": str(k), "m": str(m),
                                        "technique": "reed_sol_van"})
 
-    # -- parity gate (small sample; host oracle) ----------------------------
     log("parity gate: 4 stripes x 4 KiB vs host GF oracle")
-    rng = np.random.default_rng(0)
     sample = rng.integers(0, 256, size=(4, k, 4096), dtype=np.uint8)
     got = np.asarray(codec.encode_batch(sample, out_np=True))
     for b in range(4):
         want = gf_matmul(gen[k:], sample[b])
         if not np.array_equal(got[b], want):
-            RESULT["error"] = "byte parity failure"
-            emit()
-            return 1
+            raise RuntimeError("byte parity failure")
     log("parity gate passed")
 
-    # -- device-resident stripe batch --------------------------------------
-    # the tunnel chip is shared: transient RESOURCE_EXHAUSTED from
-    # co-tenants is expected -- retry with escalating delay, shrink batch
+    # staging with back-off: the tunnel chip is shared; transient
+    # RESOURCE_EXHAUSTED from co-tenants is expected
     fails = 0
     while True:
         try:
@@ -186,7 +188,7 @@ def main() -> int:
                 f"(batch={batch})")
             data = _device_batch(rng, batch, k, chunk)
             break
-        except Exception as e:  # OOM etc: retry, then back off
+        except Exception as e:
             fails += 1
             log(f"staging failed ({type(e).__name__}: {str(e)[:80]}); "
                 f"retry {fails}")
@@ -194,43 +196,168 @@ def main() -> int:
                 batch = max(8, (batch // 2 // 8) * 8)
             time.sleep(min(20, 3 * fails))
             if batch < 8 or time.monotonic() > deadline - 45:
-                RESULT["error"] = f"device alloc failed: {e}"
-                emit()
-                return 1
+                raise RuntimeError(f"device alloc failed: {e}")
 
-    # -- TPU encode ---------------------------------------------------------
     log("encode: compile + timing")
     enc_dt, enc_iters, parity = _time_launches(
         lambda: codec.encode_batch(data),
         lambda o: o.block_until_ready(), deadline)
     gibps = batch * k * chunk / enc_dt / 2**30
-    log(f"encode: {gibps:.1f} GiB/s ({enc_iters} iters, {enc_dt*1e3:.2f} ms/launch)")
+    log(f"encode: {gibps:.1f} GiB/s ({enc_iters} iters, "
+        f"{enc_dt*1e3:.2f} ms/launch)")
 
-    # -- decode (2 erasures: one data chunk, one parity chunk) --------------
     erasures = [1, 9]
     decode_index = [i for i in range(k + m) if i not in erasures][:k]
     full = jnp.concatenate([data, parity], axis=1)
     full.block_until_ready()
-    lost = full[:, jnp.asarray(erasures)]       # keep for the byte check
+    lost = full[:, jnp.asarray(erasures)]
     survivors = full[:, jnp.asarray(decode_index)]
     survivors.block_until_ready()
-    del data, parity, full                      # bound the HBM footprint
+    del data, parity, full
     log("decode: compile + timing")
     dec_dt, dec_iters, rec = _time_launches(
         lambda: codec.decode_batch(erasures, survivors),
         lambda o: o.block_until_ready(), deadline)
     dec_gibps = batch * k * chunk / dec_dt / 2**30
     log(f"decode: {dec_gibps:.1f} GiB/s ({dec_iters} iters)")
+    if not bool(jnp.array_equal(rec, lost)):
+        raise RuntimeError("decode parity failure")
+    log("decode recovered chunks byte-exact")
+    return {"encode_GiBps": round(gibps, 2),
+            "decode_GiBps": round(dec_gibps, 2),
+            "batch": batch, "stripe_bytes": stripe}
 
-    ok = bool(jnp.array_equal(rec, lost))
-    if not ok:
-        RESULT["error"] = "decode parity failure"
+
+def _cauchy_decode(rng, deadline):
+    """Cauchy k=10,m=4, 2-erasure decode: the matrix-inverse path."""
+    from ceph_tpu.ec import registry
+    import jax.numpy as jnp
+
+    k, m = 10, 4
+    chunk = 1 << 17                  # ~1.25 MiB stripes
+    batch = 128
+    codec = registry().factory("tpu", {"k": str(k), "m": str(m),
+                                       "technique": "cauchy"})
+    data = _device_batch(rng, batch, k, chunk)
+    parity = codec.encode_batch(data)
+    parity.block_until_ready()
+    erasures = [2, 11]
+    decode_index = [i for i in range(k + m) if i not in erasures][:k]
+    full = jnp.concatenate([data, parity], axis=1)
+    lost = full[:, jnp.asarray(erasures)]
+    survivors = full[:, jnp.asarray(decode_index)]
+    survivors.block_until_ready()
+    del data, parity, full
+    dt, iters, rec = _time_launches(
+        lambda: codec.decode_batch(erasures, survivors),
+        lambda o: o.block_until_ready(), deadline)
+    if not bool(jnp.array_equal(rec, lost)):
+        raise RuntimeError("cauchy decode parity failure")
+    gibps = batch * k * chunk / dt / 2**30
+    log(f"cauchy k10m4 decode: {gibps:.1f} GiB/s ({iters} iters)")
+    return round(gibps, 2)
+
+
+def _marshal_4k(rng, deadline):
+    """RS k8m3 on 4 KiB chunks INCLUDING host->device upload and
+    parity download -- the small-op marshaling regime."""
+    import jax
+    from ceph_tpu.ec import registry
+
+    k, m = 8, 3
+    chunk = 4096
+    batch = 2048                     # 64 MiB of 4 KiB chunks
+    codec = registry().factory("tpu", {"k": str(k), "m": str(m),
+                                       "technique": "reed_sol_van"})
+    host = rng.integers(0, 256, size=(batch, k, chunk), dtype=np.uint8)
+
+    def once():
+        dev = jax.device_put(host)
+        return np.asarray(codec.encode_batch(dev))
+
+    once()                           # compile + warm
+    iters = 4
+    # EVERY iteration pays upload AND download -- the whole point of
+    # this config is the marshaling cost, so nothing may amortize
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        once()
+    dt = (time.perf_counter() - t0) / iters
+    gibps = batch * k * chunk / dt / 2**30
+    log(f"4KiB marshaling encode (upload+launch+download): "
+        f"{gibps:.1f} GiB/s ({iters} iters)")
+    return round(gibps, 2)
+
+
+def _crush_batch(deadline):
+    """10M PG->OSD mappings over a 1000-OSD straw2 map, vectorized
+    (BASELINE config 5), via the standalone crush_bench harness."""
+    budget = deadline - time.monotonic() - 20
+    if budget < 30:
+        return None
+    try:
+        res = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.tools.crush_bench",
+             "--pgs", "10000000", "--verify", "128"],
+            timeout=budget, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = res.stdout.strip().splitlines()[-1]
+        j = json.loads(line)
+        if j.get("error"):
+            log(f"crush bulk error: {j['error']}")
+            return None
+        mps = j["value"] / 1e6
+        log(f"crush bulk: {mps:.1f} M mappings/s")
+        return round(mps, 2)
+    except Exception as e:
+        log(f"crush bulk skipped: {type(e).__name__}: {str(e)[:80]}")
+        return None
+
+
+def main() -> int:
+    deadline = T0 + float(os.environ.get("BENCH_DEADLINE_S", "270"))
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(int(deadline - T0 + 60))
+    threading.Thread(target=_watchdog, args=(deadline,),
+                     daemon=True).start()
+
+    log("probing backend reachability (child process, retry loop)")
+    if not _backend_reachable(deadline):
+        RESULT["error"] = "tpu backend unreachable (tunnel down)"
         emit()
         return 1
-    log("decode recovered chunks byte-exact")
+    log("backend probe ok")
+    from ceph_tpu.native import gf8_matmul
+    from ceph_tpu.gf import gen_rs_matrix
+    import jax
 
-    # -- CPU baseline (native AVX2, single thread) ---------------------------
+    log(f"jax backend={jax.default_backend()} devices={jax.devices()}")
+    rng = np.random.default_rng(0)
+
+    head = _headline(rng, deadline)
+    configs = {}
+    for name, fn in (("cauchy_k10m4_decode_GiBps",
+                      lambda: _cauchy_decode(rng, deadline)),
+                     ("rs_k8m3_4k_marshal_GiBps",
+                      lambda: _marshal_4k(rng, deadline)),
+                     ("crush_10m_Mmapss",
+                      lambda: _crush_batch(deadline))):
+        if time.monotonic() > deadline - 40:
+            log(f"skipping {name}: deadline margin")
+            break
+        try:
+            val = fn()
+            if val is not None:
+                configs[name] = val
+        except Exception as e:
+            log(f"{name} failed: {type(e).__name__}: {str(e)[:100]}")
+            configs[name] = {"error": str(e)[:100]}
+
+    # CPU baseline (native AVX2, single thread, ISA-L split-nibble
+    # technique -- the repo's own build; no linked ISA-L exists here)
     log("cpu baseline: native gf8.cc AVX2 single thread")
+    k, m = 8, 3
+    gen = gen_rs_matrix(k + m, k)
     base_n = 1 << 22
     base_data = rng.integers(0, 256, size=(k, base_n), dtype=np.uint8)
     gf8_matmul(gen[k:], base_data)  # warm tables
@@ -242,14 +369,16 @@ def main() -> int:
     base_gibps = k * base_n / base_dt / 2**30
     log(f"cpu baseline: {base_gibps:.2f} GiB/s")
 
-    combined = 2 / (1 / gibps + 1 / dec_gibps)  # harmonic: encode+decode
+    enc, dec = head["encode_GiBps"], head["decode_GiBps"]
+    combined = 2 / (1 / enc + 1 / dec)
     RESULT.update({
         "value": round(combined, 2),
         "vs_baseline": round(combined / base_gibps, 2),
-        "encode_GiBps": round(gibps, 2),
-        "decode_GiBps": round(dec_gibps, 2),
         "cpu_baseline_GiBps": round(base_gibps, 2),
-        "batch": batch, "stripe_bytes": stripe,
+        "baseline_note": "own AVX2 gf8.cc single-thread "
+                         "(ISA-L technique; no linked ISA-L in image)",
+        "configs": configs,
+        **head,
     })
     emit()
     return 0

@@ -172,78 +172,149 @@ def _make_pallas_batch_fn(r8: int, k: int, b: int, l: int, tile: int,
 
 
 # ---------------------------------------------------------------------------
-# MXU-packed kernel (v2): the original kernel keeps the systolic array
-# ~9% utilized -- the bit-matmul's contraction is only 8k<=64 of the
+# MXU-packed kernel family (v2/v3): the v1 kernel keeps the systolic
+# array ~9% utilized -- the bit-matmul contraction is only 8k<=64 of the
 # MXU's 128 rows, and the int32-widened unpack plus the sublane-strided
-# pack burn VPU cycles on relayouts.  This variant:
-#   * packs TWO stripes per grid step so the contraction is 16k (=128
-#     for the headline k=8): every MXU column-cycle carries two byte
-#     columns of work;
-#   * unpacks with int8 mask-compares concatenated PLANE-MAJOR (no
-#     int32 widening, no stack+reshape relayout) against a column-
-#     permuted W;
-#   * packs with the same (r,8,T) shift-sum but on the un-interleaved
-#     row halves.
+# pack burn VPU cycles on relayouts.  This family is parameterized so
+# the best point can be AUTOTUNED on real hardware (tools/ec_autotune.py
+# writes ceph_tpu/ops/gf2_tuned.json):
+#   * group g: stripes packed per grid step; contraction is 8*k*g (=128
+#     for the headline k=8 at g=2) so every MXU column-cycle carries g
+#     byte columns of work;
+#   * unpack "concat" (8 mask-compares concatenated plane-major, no
+#     int32 widening) or "bcast" (one broadcast compare + reshape);
+#   * matmul dtype int8 (MXU int path) or bf16 (MXU native path; bit
+#     counts <=128 are exact in bf16);
+#   * pack "vpu" (shift+sum over an (r,8,T) view) or "mxu" (a second
+#     tiny matmul against a power-of-two matrix, keeping the relayout
+#     on the systolic array);
+#   * lane tile T.
 # Byte-identical to the host path; selected at runtime with a parity
 # self-check and transparent fallback to the v1 kernel.
 
+G2_DEFAULT = {"unpack": "concat", "mm": "int8", "pack": "vpu",
+              "tile": LANE_TILE}
+_TUNED_PATH = os.path.join(os.path.dirname(__file__), "gf2_tuned.json")
+
+
+@functools.lru_cache(maxsize=1)
+def _tuned_cfgs() -> dict:
+    """{str(k): cfg} autotuned on hardware; absent file = defaults."""
+    try:
+        import json
+        with open(_TUNED_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _g2_cfg(k: int) -> dict:
+    cfg = dict(G2_DEFAULT)
+    cfg.update(_tuned_cfgs().get(str(k), {}))
+    env = os.environ.get("CEPH_TPU_G2_CFG")
+    if env:
+        for part in env.split(","):
+            key, _, val = part.partition("=")
+            cfg[key.strip()] = (int(val) if val.strip().isdigit()
+                                else val.strip())
+    return cfg
+
+
+def pick_group(k: int, b: int) -> int:
+    """Largest g with contraction 8*k*g <= 128 that divides the batch."""
+    g = max(1, 16 // k)
+    while g > 1 and (b % g or 8 * k * g > 128):
+        g //= 2
+    return g
+
+
 @functools.lru_cache(maxsize=64)
-def _w_g2_planemajor(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
-    """(2*8r, 16k) int8: block-diagonal-by-stripe W whose columns match
-    the plane-major concat layout of unpacked concat(stripeA, stripeB):
-    RHS row s*2k + j  <->  bit s of chunk j (j<k: stripe A, else B)."""
+def _w_gN_planemajor(mat_bytes: bytes, r: int, k: int,
+                     g: int) -> np.ndarray:
+    """(g*8r, 8*g*k): block-diagonal-by-stripe W whose columns match the
+    plane-major layout of the unpacked concat of g stripes' chunks:
+    RHS row s*(g*k) + j  <->  bit s of chunk j (stripe = j // k)."""
     w = _bitmatrix_cached(mat_bytes, r, k)      # (8r, 8k), col 8j+s
     r8 = 8 * r
-    out = np.zeros((2 * r8, 16 * k), np.int8)
+    gk = g * k
+    out = np.zeros((g * r8, 8 * gk), np.int8)
     for s in range(8):
-        for j in range(2 * k):
+        for j in range(gk):
             stripe, jj = divmod(j, k)
-            out[stripe * r8:(stripe + 1) * r8, s * 2 * k + j] = \
+            out[stripe * r8:(stripe + 1) * r8, s * gk + j] = \
                 w[:, 8 * jj + s]
     return out
 
 
-def _unpack_planes_i8(x):
-    """(nk, t) uint8 -> (8*nk, t) int8, plane-major, no i32 widening."""
-    ps = [(x & np.uint8(1 << s)).astype(jnp.bool_).astype(jnp.int8)
-          for s in range(8)]
-    return jnp.concatenate(ps, axis=0)
+def _kernel_body_gN(r8: int, k: int, g: int, tile: int, unpack: str,
+                    mm: str, pack: str):
+    r = r8 // 8
+    gk = g * k
+
+    def _pack_mat_iota():
+        # (g*r, g*8r) with P[i, 8i+s] = 2**s, built in-kernel (pallas
+        # cannot capture array constants) from iotas
+        rows = jax.lax.broadcasted_iota(jnp.int32, (g * r, g * r8), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (g * r, g * r8), 1)
+        pow2 = (1 << (cols % 8))
+        return jnp.where(cols // 8 == rows, pow2, 0).astype(jnp.bfloat16)
+
+    def kernel(w_ref, d_ref, o_ref):
+        x = d_ref[...].reshape(gk, tile)             # g stripes' chunks
+        if unpack == "bcast":
+            masks = (1 << jax.lax.broadcasted_iota(
+                jnp.int32, (8, 1, 1), 0)).astype(jnp.uint8)
+            bits = (x[None] & masks) != 0            # (8, gk, T)
+            bits = bits.reshape(8 * gk, tile)
+        else:
+            ps = [(x & np.uint8(1 << s)).astype(jnp.bool_)
+                  for s in range(8)]
+            bits = jnp.concatenate(ps, axis=0)       # (8gk, T) plane-major
+        if mm == "bf16":
+            # 0/1 entries, contraction <=128: sums are exact in bf16
+            acc = jax.lax.dot_general(
+                w_ref[:].astype(jnp.bfloat16), bits.astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(jnp.int32) & 1
+        else:
+            acc = jax.lax.dot_general(
+                w_ref[:], bits.astype(jnp.int8),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32) & 1     # (g*8r, T)
+        if pack == "mxu":
+            out = jax.lax.dot_general(
+                _pack_mat_iota(), acc.astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)       # (g*r, T) exact
+            o_ref[...] = out.astype(jnp.uint8).reshape(g, r, tile)
+        else:
+            # global row stripe*8r + 8i + t == ((stripe*r + i)*8) + t,
+            # so one reshape groups each output byte's 8 bit rows
+            b = acc.reshape(g * r, 8, tile)
+            shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
+            o_ref[...] = ((b << shifts).sum(axis=1).astype(jnp.uint8)
+                          .reshape(g, r, tile))
+    return kernel
 
 
-def _pack_rows(acc, r: int):
-    t = acc.shape[-1]
-    b = acc.reshape(r, 8, t)
-    shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
-    return (b << shifts).sum(axis=1).astype(jnp.uint8)
-
-
-def _make_pallas_batch_fn_g2(r8: int, k: int, b: int, l: int, tile: int,
+def _make_pallas_batch_fn_gN(r8: int, k: int, b: int, l: int, g: int,
+                             tile: int, unpack: str, mm: str, pack: str,
                              interpret: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     r = r8 // 8
-
-    def kernel(w_ref, d_ref, o_ref):
-        x = jnp.concatenate([d_ref[0], d_ref[1]], axis=0)   # (2k, T)
-        bits = _unpack_planes_i8(x)                  # (16k, T)
-        acc = jax.lax.dot_general(
-            w_ref[:], bits, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32) & 1           # (2*8r, T)
-        o_ref[0] = _pack_rows(acc[:r8], r)
-        o_ref[1] = _pack_rows(acc[r8:], r)
-
     fn = pl.pallas_call(
-        kernel,
+        _kernel_body_gN(r8, k, g, tile, unpack, mm, pack),
         out_shape=jax.ShapeDtypeStruct((b, r, l), jnp.uint8),
-        grid=(b // 2, l // tile),
+        grid=(b // g, l // tile),
         in_specs=[
-            pl.BlockSpec((2 * r8, 16 * k), lambda i, j: (0, 0),
+            pl.BlockSpec((g * r8, 8 * g * k), lambda i, j: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((2, k, tile), lambda i, j: (i, 0, j),
+            pl.BlockSpec((g, k, tile), lambda i, j: (i, 0, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((2, r, tile), lambda i, j: (i, 0, j),
+        out_specs=pl.BlockSpec((g, r, tile), lambda i, j: (i, 0, j),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )
@@ -260,9 +331,9 @@ def _compiled(r8: int, k: int, n_padded: int, use_pallas: bool):
 
 
 def clear_kernel_cache() -> None:
-    for fn in (_compiled, _compiled_batch, _compiled_batch_g2,
-               _w_g2_device, _w_g2_planemajor, _bitmatrix_cached,
-               _bitmatrix_device):
+    for fn in (_compiled, _compiled_batch, _compiled_batch_gN,
+               _w_gN_device, _w_gN_planemajor, _bitmatrix_cached,
+               _bitmatrix_device, _tuned_cfgs):
         getattr(fn, "cache_clear", lambda: None)()
     _g2_health.clear()
 
@@ -310,12 +381,17 @@ def gf_matmul_device(matrix: np.ndarray, data, *, out_np: bool = True):
 
 
 @functools.lru_cache(maxsize=256)
-def _w_g2_device(mat_bytes: bytes, r: int, k: int):
-    return jax.device_put(_w_g2_planemajor(mat_bytes, r, k))
+def _w_gN_device(mat_bytes: bytes, r: int, k: int, g: int, mm: str):
+    w = _w_gN_planemajor(mat_bytes, r, k, g)
+    if mm == "bf16":
+        w = w.astype(jnp.bfloat16)
+    return jax.device_put(w)
 
 
-def _pick_tile(l: int) -> int:
+def _pick_tile(l: int, want: int = LANE_TILE) -> int:
     """Lane-tile ladder shared by the batch kernels; 0 = ineligible."""
+    if l % want == 0:
+        return want
     if l % LANE_TILE == 0:
         return LANE_TILE
     if l <= LANE_TILE and l % 128 == 0:
@@ -324,47 +400,59 @@ def _pick_tile(l: int) -> int:
 
 
 @functools.lru_cache(maxsize=512)
-def _compiled_batch_g2(r8: int, k: int, b: int, l: int):
+def _compiled_batch_gN(r8: int, k: int, b: int, l: int, g: int,
+                       unpack: str, mm: str, pack: str, tile_want: int):
     interpret = bool(os.environ.get("CEPH_TPU_PALLAS_INTERPRET"))
-    tile = _pick_tile(l)
+    tile = _pick_tile(l, tile_want)
     if not tile:
         return None
-    return _make_pallas_batch_fn_g2(r8, k, b, l, tile,
-                                    interpret=interpret)
+    return _make_pallas_batch_fn_gN(r8, k, b, l, g, tile, unpack, mm,
+                                    pack, interpret=interpret)
 
 
-# per (matrix, shape) health of the v2 kernel: None=untested (parity
-# gate runs on first use), True=good, False=fall back to v1
+# per (matrix, shape, cfg) health of the packed kernel: None=untested
+# (parity gate runs on first use), True=good, False=fall back to v1
 _g2_health: dict[tuple, bool] = {}
 
 
-def _try_g2(matrix: np.ndarray, xd, b: int, k: int, l: int):
+def _try_g2(matrix: np.ndarray, xd, b: int, k: int, l: int,
+            cfg: dict | None = None):
     """Run the MXU-packed kernel when eligible; returns the output or
     None (ineligible / failed / parity-rejected -> caller falls back)."""
     if os.environ.get("CEPH_TPU_NO_G2") or not _want_pallas():
         return None
-    if k > 8 or k < 1 or b % 2 or b < 2:
-        return None                  # contraction 16k must fit 128 rows
+    cfg = cfg or _g2_cfg(k)
+    g = int(cfg.get("g") or pick_group(k, b))
+    if 8 * k * g > 128 or b % g:
+        # a tuned g incompatible with THIS batch (odd tail batch)
+        # clamps to a compatible group instead of losing the packed
+        # kernel entirely
+        g = pick_group(k, b)
+    if 8 * k * g > 128 or b % g or b < g:
+        return None
     mat_bytes = matrix.tobytes()
     r = matrix.shape[0]
-    key = (mat_bytes, b, l)
+    key = (mat_bytes, b, l, tuple(sorted(cfg.items())), g)
     if _g2_health.get(key) is False:
         return None
     try:
-        fn = _compiled_batch_g2(8 * r, k, b, l)
+        fn = _compiled_batch_gN(8 * r, k, b, l, g, cfg["unpack"],
+                                cfg["mm"], cfg["pack"],
+                                int(cfg.get("tile", LANE_TILE)))
         if fn is None:
             _g2_health[key] = False
             return None
-        w2 = _w_g2_device(mat_bytes, r, k)
+        w2 = _w_gN_device(mat_bytes, r, k, g, cfg["mm"])
         out = fn(w2, xd)
         if key not in _g2_health:
             # one-time byte-parity gate vs the host oracle on a small
             # slice; a silently-wrong kernel must never serve
             from ..gf import gf_matmul
             ncheck = min(256, l)
-            got = np.asarray(out[:2, :, :ncheck])
-            sample = np.asarray(xd[:2, :, :ncheck])
-            for i in range(2):
+            nb = min(g, 2)
+            got = np.asarray(out[:nb, :, :ncheck])
+            sample = np.asarray(xd[:nb, :, :ncheck])
+            for i in range(nb):
                 if not np.array_equal(got[i],
                                       gf_matmul(matrix, sample[i])):
                     _g2_health[key] = False

@@ -1,0 +1,145 @@
+"""Autotune the MXU-packed GF(2) kernel family on real hardware.
+
+Sweeps the (unpack, mm dtype, pack, tile, group) space of
+ceph_tpu/ops/gf2kernels._make_pallas_batch_fn_gN on a device-resident
+stripe batch, parity-gates every candidate against the host oracle,
+and writes the winner per k to ceph_tpu/ops/gf2_tuned.json -- the
+config gf_matmul_batch_device serves by default from then on.
+
+The reference tunes its SIMD technique per-CPU at plugin load
+(src/erasure-code/isa/ErasureCodeIsa.cc picks AVX2/AVX512 paths); this
+is the TPU equivalent, run once per hardware generation:
+
+    python -m ceph_tpu.tools.ec_autotune --k 8 --m 3 --write
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def stage_batch(rng, batch: int, k: int, chunk: int):
+    import jax
+    import jax.numpy as jnp
+    assert batch % 8 == 0, "batch must be a multiple of 8"
+    seed_rows = min(batch, 8)
+    seed = rng.integers(0, 256, size=(seed_rows, k, chunk),
+                        dtype=np.uint8)
+    dev = jax.device_put(seed)
+    out = jnp.tile(dev, (batch // seed_rows, 1, 1))
+    out.block_until_ready()
+    return out
+
+
+def time_fn(fn, w, xd, iters: int = 8) -> float:
+    out = fn(w, xd)
+    out.block_until_ready()          # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(w, xd)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+def sweep(k: int, m: int, batch: int, chunk: int,
+          budget_s: float = 600.0) -> list[dict]:
+    from ..gf import gen_rs_matrix, gf_matmul
+    from ..ops import gf2kernels as G
+    import jax.numpy as jnp
+
+    t_start = time.monotonic()
+    gen = gen_rs_matrix(k + m, k)
+    mat = np.ascontiguousarray(gen[k:], np.uint8)
+    rng = np.random.default_rng(0)
+    xd = stage_batch(rng, batch, k, chunk)
+    # oracle slice for the parity gate
+    sample = np.asarray(xd[:2, :, :512])
+    want = [gf_matmul(mat, sample[i]) for i in range(2)]
+
+    g_max = G.pick_group(k, batch)
+    groups = sorted({g for g in (1, 2, 4) if g <= g_max})
+    tiles = [t for t in (4096, 8192, 16384, 32768) if chunk % t == 0]
+    results = []
+    combos = list(itertools.product(
+        groups, ("concat", "bcast"), ("int8", "bf16"), ("vpu", "mxu"),
+        tiles))
+    log(f"sweeping {len(combos)} configs (k={k} m={m} batch={batch} "
+        f"chunk={chunk})")
+    for g, unpack, mm, pack, tile in combos:
+        if time.monotonic() - t_start > budget_s:
+            log("budget exhausted; stopping sweep")
+            break
+        tag = f"g={g} unpack={unpack} mm={mm} pack={pack} tile={tile}"
+        try:
+            import os
+            fn = G._make_pallas_batch_fn_gN(
+                8 * m, k, batch, chunk, g, tile, unpack, mm, pack,
+                interpret=bool(os.environ.get(
+                    "CEPH_TPU_PALLAS_INTERPRET")))
+            w = G._w_gN_device(mat.tobytes(), m, k, g, mm)
+            out = fn(w, xd)
+            got = np.asarray(out[:2, :, :512])
+            if not all(np.array_equal(got[i], want[i]) for i in (0, 1)):
+                log(f"  {tag}: PARITY FAIL")
+                continue
+            dt = time_fn(fn, w, xd)
+            gibps = batch * k * chunk / dt / 2**30
+            log(f"  {tag}: {gibps:.1f} GiB/s")
+            results.append({"g": g, "unpack": unpack, "mm": mm,
+                            "pack": pack, "tile": tile,
+                            "gibps": round(gibps, 2)})
+        except Exception as e:
+            log(f"  {tag}: ERROR {type(e).__name__}: {str(e)[:100]}")
+    return sorted(results, key=lambda r: -r["gibps"])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--m", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=256,
+                    help="stripes per launch (rounded to a multiple of 8)")
+    ap.add_argument("--chunk", type=int, default=1 << 17)
+    ap.add_argument("--budget-s", type=float, default=600.0)
+    ap.add_argument("--write", action="store_true",
+                    help="persist the winner to gf2_tuned.json")
+    args = ap.parse_args(argv)
+
+    import jax
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    args.batch = max(8, (args.batch // 8) * 8)
+    results = sweep(args.k, args.m, args.batch, args.chunk,
+                    args.budget_s)
+    if not results:
+        log("no working config found")
+        return 1
+    best = results[0]
+    print(json.dumps({"k": args.k, "best": best,
+                      "top5": results[:5]}, indent=2))
+    if args.write:
+        from ..ops.gf2kernels import _TUNED_PATH
+        try:
+            with open(_TUNED_PATH) as f:
+                tuned = json.load(f)
+        except Exception:
+            tuned = {}
+        tuned[str(args.k)] = {kk: best[kk] for kk in
+                              ("g", "unpack", "mm", "pack", "tile")}
+        with open(_TUNED_PATH, "w") as f:
+            json.dump(tuned, f, indent=2, sort_keys=True)
+        log(f"wrote {_TUNED_PATH}: k={args.k} -> {tuned[str(args.k)]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

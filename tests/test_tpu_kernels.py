@@ -88,33 +88,64 @@ def test_tpu_plugin_batch_roundtrip(registry):
     assert np.array_equal(rec[:, 1, :], full[:, 9, :])
 
 
-def test_pallas_g2_kernel_interpret_parity():
-    """The MXU-packed v2 kernel (two stripes per step, plane-major
-    int8 unpack, contraction 16k) in interpret mode, byte-exact vs the
-    host oracle, encode and decode shapes."""
+def test_pallas_gN_kernel_interpret_parity():
+    """The MXU-packed kernel family (g stripes per step, plane-major
+    unpack, contraction 8kg) in interpret mode, byte-exact vs the host
+    oracle across every (unpack, mm, pack) variant, encode and decode
+    shapes."""
+    import itertools
     import jax.numpy as jnp
-    from ceph_tpu.ops.gf2kernels import _make_pallas_batch_fn_g2, \
-        _w_g2_planemajor
+    from ceph_tpu.ops.gf2kernels import _make_pallas_batch_fn_gN, \
+        _w_gN_planemajor, pick_group
     from ceph_tpu.gf import build_decode_matrix
 
     rng = np.random.default_rng(11)
     k, m, b, l = 8, 3, 4, 512
     gen = gen_rs_matrix(k + m, k)
     data = rng.integers(0, 256, size=(b, k, l)).astype(np.uint8)
+    g = pick_group(k, b)
+    assert g == 2
 
     for mat in (gen[k:],
                 build_decode_matrix(gen, k, [1, 9])[0]):
         mat = np.ascontiguousarray(mat, np.uint8)
-        w2 = _w_g2_planemajor(mat.tobytes(), mat.shape[0], k)
-        fn = _make_pallas_batch_fn_g2(8 * mat.shape[0], k, b, l, 256,
-                                      interpret=True)
-        got = np.asarray(fn(jnp.asarray(w2), jnp.asarray(data)))
-        for i in range(b):
-            assert np.array_equal(got[i], gf_matmul(mat, data[i])), i
+        wn = _w_gN_planemajor(mat.tobytes(), mat.shape[0], k, g)
+        for unpack, mm, pack in itertools.product(
+                ("concat", "bcast"), ("int8", "bf16"), ("vpu", "mxu")):
+            w = jnp.asarray(wn.astype(jnp.bfloat16) if mm == "bf16"
+                            else wn)
+            fn = _make_pallas_batch_fn_gN(
+                8 * mat.shape[0], k, b, l, g, 256, unpack, mm, pack,
+                interpret=True)
+            got = np.asarray(fn(w, jnp.asarray(data)))
+            for i in range(b):
+                assert np.array_equal(got[i], gf_matmul(mat, data[i])), \
+                    (unpack, mm, pack, i)
+
+
+def test_pallas_gN_group4_k4():
+    """k=4 packs FOUR stripes per grid step (contraction 128)."""
+    import jax.numpy as jnp
+    from ceph_tpu.ops.gf2kernels import _make_pallas_batch_fn_gN, \
+        _w_gN_planemajor, pick_group
+
+    rng = np.random.default_rng(13)
+    k, m, b, l = 4, 2, 8, 256
+    gen = gen_rs_matrix(k + m, k)
+    data = rng.integers(0, 256, size=(b, k, l)).astype(np.uint8)
+    g = pick_group(k, b)
+    assert g == 4
+    mat = np.ascontiguousarray(gen[k:], np.uint8)
+    wn = _w_gN_planemajor(mat.tobytes(), m, k, g)
+    fn = _make_pallas_batch_fn_gN(8 * m, k, b, l, g, 256, "concat",
+                                  "int8", "vpu", interpret=True)
+    got = np.asarray(fn(jnp.asarray(wn), jnp.asarray(data)))
+    for i in range(b):
+        assert np.array_equal(got[i], gf_matmul(mat, data[i])), i
 
 
 def test_g2_selection_and_fallback(monkeypatch):
-    """gf_matmul_batch_device serves the v2 kernel when healthy and
+    """gf_matmul_batch_device serves the packed kernel when healthy and
     falls back transparently when the kernel errors."""
     import ceph_tpu.ops.gf2kernels as g
 
@@ -128,12 +159,11 @@ def test_g2_selection_and_fallback(monkeypatch):
     out = g.gf_matmul_batch_device(gen[k:], data, out_np=True)
     for i in range(b):
         assert np.array_equal(out[i], gf_matmul(gen[k:], data[i]))
-    mat = np.ascontiguousarray(gen[k:], np.uint8)
-    assert g._g2_health.get((mat.tobytes(), b, l)) is True
+    assert any(v is True for v in g._g2_health.values())
 
-    # sabotage the g2 compile: the fallback must still serve parity
+    # sabotage the packed compile: the fallback must still serve parity
     g.clear_kernel_cache()
-    monkeypatch.setattr(g, "_compiled_batch_g2",
+    monkeypatch.setattr(g, "_compiled_batch_gN",
                         lambda *a: (_ for _ in ()).throw(RuntimeError()))
     out = g.gf_matmul_batch_device(gen[k:], data, out_np=True)
     for i in range(b):

@@ -650,8 +650,30 @@ class Monitor:
             await self.propose(inc)
 
     async def _h_osd_alive(self, conn, msg) -> None:
+        """MOSDAlive: clears pending failure reports and, when the OSD
+        asks (want_up_thru), bumps its up_thru in the map so peering
+        can prove the new interval went active (OSDMonitor::
+        prepare_alive -- up_thru is the prior-interval-liveness fact
+        past_intervals pruning depends on)."""
         osd = msg.data["osd_id"]
         self.failure_reports.pop(osd, None)
+        want = int(msg.data.get("want_up_thru", 0))
+        if want and not self.is_leader and self.leader is not None:
+            # peon: forward to the leader (as _h_osd_failure does) so
+            # an OSD that can only reach us still gets its bump; the
+            # OSD's own retry loop handles the lost-reply case
+            await self._send_mon(self.leader, Message(
+                "osd_alive", dict(msg.data)))
+            return
+        if want and self.is_leader and self.osdmap.is_up(osd):
+            if self.osdmap.get_up_thru(osd) < want:
+                inc = Incremental(epoch=0)
+                inc.new_up_thru[osd] = self.osdmap.epoch
+                await self.propose(inc)
+            await conn.send(Message(
+                "osd_alive_reply",
+                {"osd_id": osd, "up_thru": self.osdmap.get_up_thru(osd),
+                 "epoch": self.osdmap.epoch}))
 
     # -- subscriptions ------------------------------------------------------
     async def _h_osd_pg_temp(self, conn, msg) -> None:

@@ -92,6 +92,11 @@ class OsdInfo:
     uuid: str = ""
     host: str = ""
     down_at_epoch: int = 0
+    # last epoch through which this OSD is known to have SERVED writes
+    # as a primary (osd_info_t::up_thru): peering bumps it before
+    # activating, so past intervals whose primary never got an up_thru
+    # bump provably never went read-write and need not be probed
+    up_thru: int = 0
 
 
 @dataclass
@@ -111,6 +116,7 @@ class Incremental:
     # pgid -> acting override; [] removes (OSDMap::Incremental
     # new_pg_temp semantics).  pg_upmap_items: pgid -> [[from, to]...]
     new_pg_temp: dict[str, list[int]] = field(default_factory=dict)
+    new_up_thru: dict[int, int] = field(default_factory=dict)
     new_pg_upmap_items: dict[str, list] = field(default_factory=dict)
     removed_pg_upmap_items: list[str] = field(default_factory=list)
     # replicated identity/topology state: a NEW leader must be able to
@@ -135,6 +141,7 @@ class Incremental:
         d["new_hosts"] = {str(k): v for k, v in self.new_hosts.items()}
         d["new_pool_snaps"] = {str(k): v
                                for k, v in self.new_pool_snaps.items()}
+        d["new_up_thru"] = {str(k): v for k, v in self.new_up_thru.items()}
         return d
 
     @classmethod
@@ -154,6 +161,8 @@ class Incremental:
             removed_ec_profiles=list(d.get("removed_ec_profiles", [])),
             new_max_osd=d.get("new_max_osd"),
             new_pg_temp=dict(d.get("new_pg_temp", {})),
+            new_up_thru={int(k): v
+                         for k, v in d.get("new_up_thru", {}).items()},
             new_pg_upmap_items=dict(d.get("new_pg_upmap_items", {})),
             removed_pg_upmap_items=list(
                 d.get("removed_pg_upmap_items", [])),
@@ -221,6 +230,10 @@ class OSDMap:
 
     def is_up(self, osd: int) -> bool:
         return osd in self.osds and self.osds[osd].up
+
+    def get_up_thru(self, osd: int) -> int:
+        info = self.osds.get(osd)
+        return 0 if info is None else info.up_thru
 
     def get_pool_by_name(self, name: str) -> PoolSpec | None:
         pid = self.pool_names.get(name)
@@ -357,6 +370,9 @@ class OSDMap:
             self.ec_profiles[name] = dict(profile)
         for name in inc.removed_ec_profiles:
             self.ec_profiles.pop(name, None)
+        for osd, e in inc.new_up_thru.items():
+            info = self.osds.setdefault(osd, OsdInfo())
+            info.up_thru = max(info.up_thru, e)
         for pgid, osds in inc.new_pg_temp.items():
             if osds:
                 self.pg_temp[pgid] = list(osds)
@@ -383,7 +399,8 @@ class OSDMap:
             "osds": {str(o): {"up": i.up, "in": i.in_cluster,
                               "weight": i.weight, "addr": i.addr,
                               "uuid": i.uuid, "host": i.host,
-                              "down_at": i.down_at_epoch}
+                              "down_at": i.down_at_epoch,
+                              "up_thru": i.up_thru}
                      for o, i in self.osds.items()},
             "pools": {str(p): asdict(s) for p, s in self.pools.items()},
             "crush": crush_to_dict(self.crush),
@@ -403,7 +420,8 @@ class OSDMap:
                 up=i["up"], in_cluster=i["in"], weight=i["weight"],
                 addr=tuple(i["addr"]) if i.get("addr") else None,
                 uuid=i.get("uuid", ""), host=i.get("host", ""),
-                down_at_epoch=i.get("down_at", 0))
+                down_at_epoch=i.get("down_at", 0),
+                up_thru=i.get("up_thru", 0))
         for p, s in d.get("pools", {}).items():
             spec = PoolSpec(**s)
             m.pools[int(p)] = spec

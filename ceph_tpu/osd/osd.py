@@ -426,6 +426,48 @@ class OSD:
     def osd_is_up(self, osd: int) -> bool:
         return osd == self.whoami or self.osdmap.is_up(osd)
 
+    async def ensure_up_thru(self, min_epoch: int,
+                             timeout: float = 30.0) -> bool:
+        """Block until the osdmap records our up_thru >= min_epoch
+        (PeeringState WaitUpThru: the primary may not activate a new
+        interval before the map proves the interval went live, or a
+        later peering could prune it as never-active and lose writes).
+
+        All waiting PGs share ONE MOSDAlive sender (the reference
+        sends one alive per map epoch per OSD, not per PG): the task
+        asks for the max wanted epoch and every waiter just watches
+        the subscribed map."""
+        self._alive_want = max(getattr(self, "_alive_want", 0),
+                               min_epoch)
+        if (getattr(self, "_alive_task", None) is None
+                or self._alive_task.done()):
+            self._alive_task = asyncio.ensure_future(self._alive_loop())
+            self._track(self._alive_task)
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self.osdmap.get_up_thru(self.whoami) < min_epoch:
+            if asyncio.get_event_loop().time() > deadline:
+                return False
+            await asyncio.sleep(0.05)
+        return True
+
+    async def _alive_loop(self) -> None:
+        """Single in-flight MOSDAlive per OSD, re-sent every 2s until
+        the map catches up to the largest wanted epoch."""
+        while self.osdmap.get_up_thru(self.whoami) < self._alive_want:
+            try:
+                await self._mon_request(
+                    "osd_alive",
+                    {"osd_id": self.whoami,
+                     "want_up_thru": self._alive_want},
+                    reply_type="osd_alive_reply", timeout=5)
+                # the reply races the map incremental; fetch once
+                await self._catch_up_maps()
+            except (asyncio.TimeoutError, ConnectionError, OSError):
+                pass
+            if self.osdmap.get_up_thru(self.whoami) >= self._alive_want:
+                return
+            await asyncio.sleep(2.0)
+
     def request_pg_temp(self, pgid: str, osds: list[int]) -> None:
         """Fire-and-forget MOSDPGTemp to the mon (an empty list clears
         the override); the map change comes back as an incremental."""
@@ -565,7 +607,14 @@ class OSD:
                 continue
             if pg.state == "active" and pg._recovery_pending():
                 pg.kick_recovery()
-            elif pg.state == "peering":
+            elif pg.state in ("peering", "incomplete", "wait_up_thru",
+                              "wait_acting_change"):
+                # incomplete re-probes each tick (a revived peer with
+                # complete history un-wedges it -- the reference reacts
+                # to MNotifyRec; the tick is our notify cadence), and a
+                # wait-state whose task DIED (e.g. up_thru timeout with
+                # the epoch moved, so peer() exited) restarts here;
+                # kick_peering is a no-op while the task still runs
                 pg.kick_peering()
             if pg.state == "active" and pg.pool.removed_snaps:
                 pg.kick_snap_trim(pg.pool.removed_snaps)

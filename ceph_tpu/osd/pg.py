@@ -63,6 +63,8 @@ class PG:
         self.up: list[int] = []
         self.acting: list[int] = []
         self.state = "initial"
+        # transition trace for introspection/tests (NamedState events)
+        self.state_history: list[str] = ["initial"]
         self.lock = asyncio.Lock()
         self._recovery_task: asyncio.Task | None = None
         self._peering_task: asyncio.Task | None = None
@@ -173,6 +175,13 @@ class PG:
             tuple(e.reqid): e.version
             for e in self.log.entries if e.reqid is not None}
 
+    def _set_state(self, state: str) -> None:
+        if state != self.state:
+            self.state = state
+            self.state_history.append(state)
+            if len(self.state_history) > 64:
+                del self.state_history[:-64]
+
     # -- role / mapping -----------------------------------------------------
     @property
     def whoami(self) -> int:
@@ -195,12 +204,22 @@ class PG:
         if up == self.up and acting == self.acting:
             return False
         if self.acting:
+            # maybe_went_rw: the closing interval could only have served
+            # writes if its primary got an up_thru bump at/after the
+            # interval start (osd_types.cc check_new_interval); the
+            # current map's up_thru can only OVERSTATE (monotone), so
+            # rw=True is the safe direction
+            prev_primary = next((o for o in self.acting if o >= 0), -1)
+            rw = (prev_primary >= 0
+                  and (self.osd.osdmap.get_up_thru(prev_primary)
+                       >= self.info.same_interval_since))
             self.past_intervals.note_interval(
-                self.info.same_interval_since, epoch - 1, self.acting)
+                self.info.same_interval_since, epoch - 1, self.acting,
+                rw=rw)
         self.up = list(up)
         self.acting = list(acting)
         self.info.same_interval_since = epoch
-        self.state = "peering" if self.is_primary() else "stray"
+        self._set_state("peering" if self.is_primary() else "stray")
         self.backend.invalidate_extents()   # interval change: stale cache
         if self._recovery_task:
             self._recovery_task.cancel()
@@ -244,9 +263,18 @@ class PG:
                     KeyError, ValueError):
                 await asyncio.sleep(0.5)
 
+    async def _await_acting_change(self, timeout: float = 10.0) -> None:
+        """WaitActingChange: a pg_temp override was requested; hold
+        peering until the map reflecting it arrives (PeeringState.h:802
+        -- queries are answered, I/O is not served).  The new map's
+        update_mapping CANCELS this task, so running the full sleep
+        always means the override never landed (mon unreachable) and
+        the caller falls back to serving the interval itself."""
+        await asyncio.sleep(timeout)
+
     async def _peer_locked(self) -> None:
         epoch = self.osd.osdmap.epoch
-        self.state = "peering"
+        self._set_state("peering")
         self.peer_info.clear()
         self.peer_log_entries.clear()
         self.peer_missing.clear()
@@ -276,9 +304,14 @@ class PG:
             if self.info.backfill_complete else []
         candidates += [(o, pi) for o, pi in self.peer_info.items()
                        if pi.backfill_complete]
-        if not candidates:      # nobody finished backfill: best effort
-            candidates = ([(self.whoami, self.info)]
-                          + list(self.peer_info.items()))
+        if not candidates:
+            # Incomplete (PeeringState.h:1377): every reachable history
+            # is mid-backfill -- no copy is known whole, and activating
+            # from an overstated log would present missing objects as
+            # present.  Hold I/O; the tick re-probes as peers come up
+            # or the interval changes.
+            self._set_state("incomplete")
+            return
         best_osd, best_info = candidates[0]
         for osd_id, pinfo in candidates[1:]:
             if pinfo.last_update > best_info.last_update:
@@ -295,10 +328,16 @@ class PG:
                     # our data is gapped but a complete peer exists:
                     # hand it the primary role via pg_temp so clients
                     # are served at full speed while IT backfills US
-                    # (OSDMonitor pg_temp / choose_acting semantics)
+                    # (OSDMonitor pg_temp / choose_acting semantics).
+                    # WaitActingChange until the override lands -- the
+                    # new interval cancels this task; a timeout means
+                    # the mon never answered and we serve it ourselves
                     temp = [best_osd] + [o for o in self.up
                                          if o >= 0 and o != best_osd]
                     self.osd.request_pg_temp(self.pgid, temp)
+                    self._set_state("wait_acting_change")
+                    await self._await_acting_change()
+                    self._set_state("peering")
             divergent = self.log.merge(auth_entries, best_info, self.missing)
             self._clean_divergent(divergent)
             self._reindex_reqids()
@@ -336,6 +375,20 @@ class PG:
             else:
                 self.peer_missing[osd_id] = PGLog.proc_replica_log(
                     pinfo, self.peer_log_entries.get(osd_id, []), auth_log)
+        # WaitUpThru (PeeringState.h:1348): before the interval may
+        # serve writes, the map must record our up_thru >= the interval
+        # start -- otherwise a future peering could prune this interval
+        # as never-active (maybe_went_rw false) and skip probing its
+        # members, losing the writes we are about to accept
+        if (self.osd.osdmap.get_up_thru(self.whoami)
+                < self.info.same_interval_since):
+            self._set_state("wait_up_thru")
+            ok = await self.osd.ensure_up_thru(
+                self.info.same_interval_since)
+            if not ok:
+                raise asyncio.TimeoutError(
+                    f"pg {self.pgid}: up_thru not recorded")
+            self._set_state("peering")
         # Activate: ship the authoritative log to the acting set
         self.info.last_epoch_started = epoch
         act_targets = [o for o in self.acting_peers()
@@ -364,7 +417,7 @@ class PG:
         if unacked:
             raise asyncio.TimeoutError(
                 f"pg {self.pgid}: no activate ack from up peers {unacked}")
-        self.state = "active"
+        self._set_state("active")
         self.persist_meta()
         if (self.missing or any(self.peer_missing.values())
                 or self.backfill_targets):
@@ -478,7 +531,7 @@ class PG:
             self.info.last_epoch_started = msg.data["epoch"]
             if not self.missing:
                 self.info.last_complete = self.info.last_update
-            self.state = "replica_active"
+            self._set_state("replica_active")
             self.persist_meta()
             return {"pgid": self.pgid, "missing": self.missing.to_dict(),
                     "from_osd": self.whoami}

@@ -265,16 +265,22 @@ class PastIntervals:
     """
 
     def __init__(self) -> None:
-        self.intervals: list[dict] = []   # {first, last, acting}
+        self.intervals: list[dict] = []   # {first, last, acting, rw}
 
     def note_interval(self, first: int, last: int,
-                      acting: list[int]) -> None:
+                      acting: list[int], rw: bool = True) -> None:
+        """``rw=False`` marks an interval whose primary never got an
+        up_thru bump: it provably never served writes (maybe_went_rw,
+        osd_types.cc check_new_interval), so its members carry nothing
+        recovery could need."""
         self.intervals.append({"first": first, "last": last,
-                               "acting": list(acting)})
+                               "acting": list(acting), "rw": bool(rw)})
 
     def probe_targets(self, current_acting: list[int]) -> set[int]:
         osds = {o for o in current_acting if o >= 0}
         for iv in self.intervals:
+            if not iv.get("rw", True):
+                continue             # provably never went read-write
             osds.update(o for o in iv["acting"] if o >= 0)
         return osds
 
@@ -292,18 +298,26 @@ class PastIntervals:
         return pi
 
     def denc(self, enc: Encoder) -> None:
-        enc.start(1, 1)
+        # v2 adds the per-interval maybe_went_rw byte MID-STREAM, so
+        # v1 decoders cannot tail-skip it: compat=2 makes them fail
+        # cleanly instead of misparsing
+        enc.start(2, 2)
         enc.list(self.intervals, lambda e, iv: (
             e.u32(iv["first"]), e.u32(iv["last"]),
-            e.list(iv["acting"], lambda e2, o: e2.i64(o))))
+            e.list(iv["acting"], lambda e2, o: e2.i64(o)),
+            e.u8(1 if iv.get("rw", True) else 0)))
         enc.finish()
 
     @classmethod
     def dedenc(cls, dec: Decoder) -> "PastIntervals":
-        dec.start(1)
+        v = dec.start(2)
         pi = cls()
-        pi.intervals = dec.list(lambda d: {
-            "first": d.u32(), "last": d.u32(),
-            "acting": d.list(lambda d2: d2.i64())})
+
+        def one(d):
+            iv = {"first": d.u32(), "last": d.u32(),
+                  "acting": d.list(lambda d2: d2.i64())}
+            iv["rw"] = bool(d.u8()) if v >= 2 else True
+            return iv
+        pi.intervals = dec.list(one)
         dec.finish()
         return pi

@@ -54,6 +54,7 @@ def _samples_pastintervals():
     pi = PastIntervals()
     pi.note_interval(3, 7, [2, 0, 1])
     pi.note_interval(8, 11, [2, -1, 1])
+    pi.note_interval(12, 12, [0, 3, 1], rw=False)
     yield pi
     yield PastIntervals()
 
@@ -102,11 +103,22 @@ def corpus_check(root: str) -> int:
                 obj = t["dec"](blob)
                 re = t["enc"](obj)
                 if re != blob:
-                    print(f"FAIL {tdir.name}/{blob_path.name}: "
-                          f"re-encode differs "
-                          f"({len(re)} vs {len(blob)} bytes)")
-                    failures += 1
-                    continue
+                    # the envelope's first byte is the struct version:
+                    # an OLD-version blob is decode-compat only (the
+                    # reference keeps per-version corpus archives the
+                    # same way); a SAME-version mismatch is a breaking
+                    # format drift and fails
+                    if blob[:1] == re[:1]:
+                        print(f"FAIL {tdir.name}/{blob_path.name}: "
+                              f"re-encode differs at same version "
+                              f"({len(re)} vs {len(blob)} bytes)")
+                        failures += 1
+                        continue
+                    if t["dump"](t["dec"](re)) != t["dump"](obj):
+                        print(f"FAIL {tdir.name}/{blob_path.name}: "
+                              f"upgraded re-encode loses semantics")
+                        failures += 1
+                        continue
                 side = blob_path.with_suffix(".json")
                 if side.exists():
                     want = json.loads(side.read_text())

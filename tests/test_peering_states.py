@@ -1,0 +1,194 @@
+"""The full peering statechart beyond the happy path: WaitUpThru,
+Incomplete, WaitActingChange, and acting-set changes mid-peering
+(PeeringState.h:645-680; the reference's statechart states this repo's
+round-3 review flagged as missing)."""
+
+import asyncio
+
+from test_backfill import wait_for
+from test_osd_cluster import make_cluster, read_result, run
+
+
+def test_wait_up_thru_gates_activation():
+    """A primary may not activate an interval until the osdmap records
+    its up_thru >= same_interval_since (PeeringState.h:1348): without
+    it a later peering could prune the interval as never-active and
+    skip probing its members."""
+    async def main():
+        c = await make_cluster(3)
+        try:
+            await c.command("osd pool create",
+                            {"name": "p", "pg_num": 4, "size": 3,
+                             "min_size": 2})
+            await c.osd_op("p", "obj", [
+                {"op": "write", "off": 0, "data": b"x"}])
+            pgid, primary, _ = c.target_for("p", "obj")
+            posd = next(o for o in c.osds if o.whoami == primary)
+            pg = posd.pgs[pgid]
+            assert pg.state == "active"
+            # the statechart passed through WaitUpThru before Activate
+            hist = pg.state_history
+            assert "wait_up_thru" in hist, hist
+            assert hist.index("wait_up_thru") < hist.index("active")
+            # and the map now proves the interval went live
+            assert (c.mon.osdmap.get_up_thru(primary)
+                    >= pg.info.same_interval_since)
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_incomplete_blocks_io_until_history_appears():
+    """When every reachable history is mid-backfill, the PG must hold
+    I/O in Incomplete (PeeringState.h:1377) instead of activating from
+    an overstated log -- and recover when a complete peer shows up."""
+    async def main():
+        c = await make_cluster(3, osd_config={
+            "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 3.0})
+        try:
+            await c.command("osd pool create",
+                            {"name": "p", "pg_num": 1, "size": 3,
+                             "min_size": 2})
+            await c.osd_op("p", "obj", [
+                {"op": "write", "off": 0, "data": b"precious"}])
+            pgid, primary, up = c.target_for("p", "obj")
+            pgs = {o.whoami: o.pgs[pgid] for o in c.osds
+                   if o.whoami in up}
+            # simulate "everyone crashed mid-backfill": no copy claims
+            # complete history
+            for pg in pgs.values():
+                pg.info.backfill_complete = False
+                pg.persist_meta()
+            ppg = pgs[primary]
+            ppg.kick_peering()
+            await wait_for(lambda: ppg.state == "incomplete",
+                           msg="pg enters incomplete")
+            assert "incomplete" in ppg.state_history
+            # I/O is refused while incomplete
+            posd = next(o for o in c.osds if o.whoami == primary)
+            reply, _ = await posd_try_read(c, pgid, primary, "obj")
+            assert reply.data.get("err") == "ENOTPRIMARY"
+            # a complete history appears (one replica finishes/was
+            # whole all along): the tick re-probe must un-wedge it
+            replica = next(i for i in pgs if i != primary)
+            pgs[replica].info.backfill_complete = True
+            pgs[replica].persist_meta()
+            await wait_for(lambda: ppg.state == "active", timeout=30,
+                           msg="pg recovers from incomplete")
+            got = await c.osd_op("p", "obj", [
+                {"op": "read", "off": 0, "len": 8}])
+            _, data = read_result(got)
+            assert data == b"precious"
+        finally:
+            await c.stop()
+    run(main())
+
+
+async def posd_try_read(c, pgid, primary, oid):
+    """One raw osd_op straight at the primary (no retry-on-
+    ENOTPRIMARY like Cluster.osd_op does)."""
+    from ceph_tpu.msg import Message
+    posd = next(o for o in c.osds if o.whoami == primary)
+    q = asyncio.Queue()
+
+    async def d(conn, msg):
+        if msg.type == "osd_op_reply":
+            await q.put(msg)
+    c.client.add_dispatcher(d)
+    await c.client.send(
+        posd.msgr.addr, f"osd.{primary}",
+        Message("osd_op", {"pgid": pgid, "oid": oid,
+                           "ops": [{"op": "read", "off": 0, "len": 8}],
+                           "epoch": c.mon.osdmap.epoch}))
+    return await asyncio.wait_for(q.get(), 10), None
+
+
+def test_wait_acting_change_hands_primary_via_pg_temp():
+    """A gapped CRUSH primary with a complete peer must request
+    pg_temp and hold in WaitActingChange until the override lands
+    (PeeringState.h:802); the temp primary serves while the gapped
+    one backfills."""
+    async def main():
+        c = await make_cluster(3)
+        try:
+            await c.command("osd pool create",
+                            {"name": "p", "pg_num": 1, "size": 3,
+                             "min_size": 2})
+            await c.osd_op("p", "obj", [
+                {"op": "write", "off": 0, "data": b"kept"}])
+            pgid, primary, up = c.target_for("p", "obj")
+            posd = next(o for o in c.osds if o.whoami == primary)
+            ppg = posd.pgs[pgid]
+            # gap the CRUSH primary's history: it must hand off
+            ppg.info.backfill_complete = False
+            ppg.log.entries.clear()
+            ppg.log.head = ppg.log.tail = ppg.info.last_update = \
+                ppg.info.log_tail = type(ppg.info.last_update)(0, 0)
+            ppg.persist_meta()
+            ppg.kick_peering()
+            # the whole dance (request -> override -> temp primary
+            # serves -> backfill -> override cleared) completes in
+            # well under a second for one object, so assert on the
+            # recorded transitions and the converged end state
+            await wait_for(
+                lambda: "wait_acting_change" in ppg.state_history,
+                msg="primary entered WaitActingChange")
+            await wait_for(
+                lambda: "stray" in ppg.state_history
+                or "replica_active" in ppg.state_history,
+                msg="pg_temp map demoted the gapped primary")
+            await wait_for(lambda: ppg.info.backfill_complete,
+                           timeout=60, msg="ex-primary backfilled")
+            await wait_for(
+                lambda: c.mon.osdmap.pg_temp.get(pgid) is None,
+                timeout=60, msg="pg_temp cleared after backfill")
+            # CRUSH order restored; data survived the whole dance
+            got = await c.osd_op("p", "obj", [
+                {"op": "read", "off": 0, "len": 4}])
+            _, data = read_result(got)
+            assert data == b"kept"
+            await wait_for(lambda: ppg.state == "active", timeout=30,
+                           msg="original primary active again")
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_acting_change_mid_peering():
+    """Marking an OSD down while its peers are mid-peering must start
+    a fresh interval that converges -- not corrupt or wedge (the
+    AdvMap/interval checks the statechart exists to serve)."""
+    async def main():
+        c = await make_cluster(4, osd_config={
+            "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 2.0})
+        try:
+            await c.command("osd pool create",
+                            {"name": "p", "pg_num": 4, "size": 3,
+                             "min_size": 2})
+            for i in range(12):
+                await c.osd_op("p", f"o{i}", [
+                    {"op": "write", "off": 0,
+                     "data": f"v{i}".encode()}])
+            pgid, primary, up = c.target_for("p", "o0")
+            # restart every PG's peering, then immediately kill a
+            # replica so the acting set changes underneath it
+            for o in c.osds:
+                for pg in o.pgs.values():
+                    if pg.is_primary():
+                        pg.kick_peering()
+            victim = next(o for o in c.osds
+                          if o.whoami in up and o.whoami != primary)
+            vid = victim.whoami
+            await victim.stop()
+            c.osds = [o for o in c.osds if o.whoami != vid]
+            await wait_for(lambda: not c.mon.osdmap.is_up(vid),
+                           timeout=30, msg="victim marked down")
+            # the cluster reconverges and every write is still there
+            for i in range(12):
+                got = await c.osd_op("p", f"o{i}", [
+                    {"op": "read", "off": 0, "len": 8}])
+                _, data = read_result(got)
+                assert data == f"v{i}".encode(), i
+        finally:
+            await c.stop()
+    run(main())

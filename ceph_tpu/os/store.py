@@ -392,3 +392,25 @@ class DBStore(ObjectStore):
         return [r[0] for r in self._conn().execute(
             "SELECT oid FROM objects WHERE coll=? AND oid>? "
             "ORDER BY oid LIMIT ?", (coll, begin, limit))]
+
+
+def make_default_store():
+    """Store factory for daemons booted without an explicit store.
+
+    CEPH_TPU_STORE selects the backend: "mem" (default),
+    "block" (BlockStore in a fresh directory under
+    $CEPH_TPU_STORE_DIR or /tmp), or "block:<dir>" (that directory --
+    a restart on the same dir remounts the same data)."""
+    import os as _os
+    spec = _os.environ.get("CEPH_TPU_STORE", "mem")
+    if spec == "mem":
+        return MemStore()
+    if spec == "block" or spec.startswith("block:"):
+        from .blockstore import BlockStore
+        _, _, path = spec.partition(":")
+        if not path:
+            import tempfile
+            base = _os.environ.get("CEPH_TPU_STORE_DIR", "/tmp")
+            path = tempfile.mkdtemp(prefix="ceph_tpu_bs_", dir=base)
+        return BlockStore(path)
+    raise ValueError(f"unknown CEPH_TPU_STORE {spec!r}")

@@ -18,7 +18,7 @@ from ..common import AdminSocket, ConfigProxy, PerfCountersCollection, \
     make_task_tracker
 from ..mon.osdmap import OSDMap, Incremental
 from ..msg import Message, Messenger
-from ..os.store import MemStore
+from ..os.store import MemStore, make_default_store
 from .pg import PG, WRITE_OPS
 from .scheduler import MClockScheduler, OpClass
 
@@ -32,7 +32,7 @@ class OSD:
                  msgr_opts: dict | None = None) -> None:
         self.msgr_opts = msgr_opts
         self.host = host
-        self.store = store or MemStore()
+        self.store = store or make_default_store()
         # identity lives in the store (OSD superblock analog,
         # OSD::read_superblock): a daemon restarted on a durable store
         # must reclaim its osd id (the mon resolves uuid->id), not

@@ -1,0 +1,265 @@
+"""BlockStore: the raw-block BlueStore analog -- allocator reuse,
+deferred-write WAL replay after a hard kill, checksum-on-read, clone
+COW sharing, checkpoint compaction."""
+
+import os
+import signal
+import struct
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ceph_tpu.os.blockstore import BLOCK, BlockStore, DEFERRED_MAX
+from ceph_tpu.os.transaction import Transaction
+
+
+def mk(path) -> BlockStore:
+    bs = BlockStore(str(path))
+    bs.mount()
+    return bs
+
+
+def w(bs, coll, oid, off, data):
+    bs.queue_transaction(Transaction().write(coll, oid, off, data))
+
+
+def test_basic_rw_and_remount(tmp_path):
+    bs = mk(tmp_path / "s")
+    bs.queue_transaction(Transaction().create_collection("c"))
+    w(bs, "c", "a", 0, b"hello world")
+    w(bs, "c", "a", 6, b"block")
+    w(bs, "c", "big", 0, os.urandom(3 * BLOCK + 123))
+    big = bs.read("c", "big")
+    assert bs.read("c", "a") == b"hello block"
+    assert bs.stat("c", "a")["size"] == 11
+    bs.queue_transaction(
+        Transaction().setattr("c", "a", "k", b"v")
+        .omap_setkeys("c", "a", {"x": b"1"}))
+    bs.umount()
+
+    bs2 = mk(tmp_path / "s")
+    assert bs2.read("c", "a") == b"hello block"
+    assert bs2.read("c", "big") == big
+    assert bs2.getattr("c", "a", "k") == b"v"
+    assert bs2.omap_get("c", "a") == {"x": b"1"}
+    bs2.umount()
+
+
+def test_allocator_reuses_freed_blocks(tmp_path):
+    bs = mk(tmp_path / "s")
+    bs.queue_transaction(Transaction().create_collection("c"))
+    big = os.urandom(DEFERRED_MAX + BLOCK)     # forces redirect path
+    w(bs, "c", "a", 0, big)
+    high_after_first = bs.alloc.high
+    bs.queue_transaction(Transaction().remove("c", "a"))
+    w(bs, "c", "b", 0, big)
+    # freed blocks were reused: the device did not grow
+    assert bs.alloc.high == high_after_first
+    assert bs.read("c", "b") == big
+    bs.umount()
+
+
+def test_truncate_and_zero(tmp_path):
+    bs = mk(tmp_path / "s")
+    bs.queue_transaction(Transaction().create_collection("c"))
+    w(bs, "c", "a", 0, b"x" * (2 * BLOCK))
+    bs.queue_transaction(Transaction().truncate("c", "a", BLOCK + 10))
+    assert bs.stat("c", "a")["size"] == BLOCK + 10
+    assert bs.read("c", "a") == b"x" * (BLOCK + 10)
+    bs.queue_transaction(Transaction().truncate("c", "a", 2 * BLOCK))
+    assert bs.read("c", "a") == \
+        b"x" * (BLOCK + 10) + b"\x00" * (BLOCK - 10)
+    bs.queue_transaction(Transaction().zero("c", "a", 5, 10))
+    assert bs.read("c", "a", 0, 20) == \
+        b"x" * 5 + b"\x00" * 10 + b"x" * 5
+    bs.umount()
+
+
+def test_clone_shares_then_cows(tmp_path):
+    bs = mk(tmp_path / "s")
+    bs.queue_transaction(Transaction().create_collection("c"))
+    content = os.urandom(2 * BLOCK)
+    w(bs, "c", "src", 0, content)
+    bs.queue_transaction(Transaction().clone("c", "src", "dst"))
+    src_blocks = set(bs.colls["c"]["src"].blocks.values())
+    dst_blocks = set(bs.colls["c"]["dst"].blocks.values())
+    assert src_blocks == dst_blocks          # shared, not copied
+    # writing the source COWs away from the shared blocks
+    w(bs, "c", "src", 0, b"Y" * 100)
+    assert bs.read("c", "dst") == content
+    assert bs.read("c", "src", 0, 100) == b"Y" * 100
+    assert bs.read("c", "src", 100) == content[100:]
+    bs.umount()
+    bs2 = mk(tmp_path / "s")
+    assert bs2.read("c", "dst") == content
+    bs2.umount()
+
+
+def test_checksum_detects_bitrot(tmp_path):
+    bs = mk(tmp_path / "s")
+    bs.queue_transaction(Transaction().create_collection("c"))
+    w(bs, "c", "a", 0, b"precious-data" * 100)
+    dev_blk = next(iter(bs.colls["c"]["a"].blocks.values()))
+    # flip a byte on the raw device behind the store's back
+    with open(bs._f("block"), "r+b") as f:
+        f.seek(dev_blk * BLOCK + 7)
+        b = f.read(1)
+        f.seek(dev_blk * BLOCK + 7)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(IOError, match="checksum"):
+        bs.read("c", "a")
+    bs.umount()
+
+
+def test_checkpoint_truncates_wal(tmp_path):
+    bs = mk(tmp_path / "s")
+    bs.queue_transaction(Transaction().create_collection("c"))
+    for i in range(8):
+        w(bs, "c", f"o{i}", 0, os.urandom(1000))
+    assert os.path.getsize(bs._f("wal")) > 0
+    bs._checkpoint()
+    assert os.path.getsize(bs._f("wal")) == 0
+    # state fully served from the checkpoint
+    bs.umount()
+    bs2 = mk(tmp_path / "s")
+    assert len(bs2.list_objects("c")) == 8
+    bs2.umount()
+
+
+CRASH_CHILD = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    from ceph_tpu.os.blockstore import BlockStore, BLOCK
+    from ceph_tpu.os.transaction import Transaction
+    bs = BlockStore({path!r})
+    bs.mount()
+    bs.queue_transaction(Transaction().create_collection("c"))
+    i = 0
+    while True:
+        t = Transaction()
+        # mix of deferred (small) and redirect (large) writes
+        t.write("c", f"small-{{i}}", 0, (f"S{{i}}:".encode()) * 100)
+        t.write("c", f"big-{{i}}", 0,
+                bytes([i % 256]) * (BLOCK * 20))
+        t.omap_setkeys("c", "small-" + str(i),
+                       {{"seq": str(i).encode()}})
+        bs.queue_transaction(t)
+        print(i, flush=True)            # ACKED: i is durable
+        i += 1
+""")
+
+
+def test_crash_replay_preserves_acked_writes(tmp_path):
+    """SIGKILL mid-commit stream; remount must recover EVERY write
+    acked before the kill (the WAL contract BlueStore's kv-sync
+    provides), with checksums intact."""
+    path = str(tmp_path / "s")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         CRASH_CHILD.format(repo=repo, path=path)],
+        stdout=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    acked = -1
+    t0 = time.time()
+    while time.time() - t0 < 20:
+        line = child.stdout.readline()
+        if line.strip().isdigit():
+            acked = int(line)
+        if acked >= 25:
+            break
+    child.send_signal(signal.SIGKILL)
+    child.wait()
+    assert acked >= 25, "child never made progress"
+
+    bs = BlockStore(path)
+    bs.mount()
+    for i in range(acked + 1):
+        got = bs.read("c", f"small-{i}")
+        assert got == (f"S{i}:".encode()) * 100, f"small-{i} lost"
+        assert bs.omap_get("c", f"small-{i}") == \
+            {"seq": str(i).encode()}
+        big = bs.read("c", f"big-{i}")
+        assert big == bytes([i % 256]) * (BLOCK * 20), f"big-{i} lost"
+    bs.umount()
+
+
+def test_torn_wal_tail_is_dropped(tmp_path):
+    """A torn final record (partial write at crash) must not poison
+    replay: everything before it recovers, the tail is ignored."""
+    bs = mk(tmp_path / "s")
+    bs.queue_transaction(Transaction().create_collection("c"))
+    w(bs, "c", "kept", 0, b"intact")
+    bs.umount()
+    # append garbage that looks like a truncated record
+    with open(str(tmp_path / "s" / "wal"), "ab") as f:
+        f.write(b"BSR1" + struct.pack("<II", 99999, 0) + b"half a rec")
+    bs2 = mk(tmp_path / "s")
+    assert bs2.read("c", "kept") == b"intact"
+    w(bs2, "c", "more", 0, b"still writable")
+    bs2.umount()
+
+
+def test_deferred_overwrite_preserves_old_data_on_crash(tmp_path):
+    """An in-place (deferred) overwrite must not touch the device
+    before its WAL record is durable: a crash in that window has to
+    leave the PREVIOUS committed content readable (BlueStore's
+    deferred-write ordering)."""
+    path = str(tmp_path / "s")
+    bs = mk(path)
+    bs.queue_transaction(Transaction().create_collection("c"))
+    w(bs, "c", "a", 0, b"FIRST" * 100)      # committed, durable
+
+    def boom(rec):
+        raise RuntimeError("crash before log fsync")
+    bs._wal_commit = boom
+    with pytest.raises(RuntimeError):
+        w(bs, "c", "a", 0, b"SECND" * 100)
+    # simulate process death: reopen the directory cold
+    os.close(bs._block_fd)
+    bs2 = BlockStore(path)
+    bs2.mount()
+    assert bs2.read("c", "a") == b"FIRST" * 100
+    bs2.umount()
+
+
+def test_truncate_tail_zero_cows_shared_block(tmp_path):
+    """Tail-zeroing on truncate must COW a block a clone still
+    references, never zero it in place under the clone."""
+    bs = mk(tmp_path / "s")
+    bs.queue_transaction(Transaction().create_collection("c"))
+    content = os.urandom(BLOCK + 500)
+    w(bs, "c", "src", 0, content)
+    bs.queue_transaction(Transaction().clone("c", "src", "dst"))
+    bs.queue_transaction(Transaction().truncate("c", "src", BLOCK + 9))
+    assert bs.read("c", "src") == content[:BLOCK + 9]
+    assert bs.read("c", "dst") == content      # clone untouched
+    bs.umount()
+
+
+def test_torn_tail_truncated_at_mount_so_later_writes_survive(tmp_path):
+    """After replay stops at a torn record, the WAL must be CUT there:
+    records appended after the garbage would be unreachable by every
+    future replay."""
+    path = str(tmp_path / "s")
+    bs = mk(path)
+    bs.queue_transaction(Transaction().create_collection("c"))
+    w(bs, "c", "kept", 0, b"intact")
+    # crash without checkpoint: drop the store, garbage the tail
+    os.close(bs._block_fd)
+    with open(os.path.join(path, "wal"), "ab") as f:
+        f.write(b"BSR1" + struct.pack("<II", 5000, 1) + b"torn")
+    bs2 = BlockStore(path)
+    bs2.mount()
+    assert bs2.read("c", "kept") == b"intact"
+    w(bs2, "c", "after", 0, b"post-tear write")
+    # crash again (no umount/checkpoint): the new record must replay
+    os.close(bs2._block_fd)
+    bs3 = BlockStore(path)
+    bs3.mount()
+    assert bs3.read("c", "kept") == b"intact"
+    assert bs3.read("c", "after") == b"post-tear write"
+    bs3.umount()

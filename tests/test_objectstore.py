@@ -1,12 +1,17 @@
 import pytest
 
 from ceph_tpu.os import Transaction, MemStore, DBStore
+from ceph_tpu.os.blockstore import BlockStore
 
 
-@pytest.fixture(params=["mem", "db"])
+@pytest.fixture(params=["mem", "db", "block"])
 def store(request, tmp_path):
     if request.param == "mem":
         return MemStore()
+    if request.param == "block":
+        bs = BlockStore(str(tmp_path / "bs"))
+        bs.mount()
+        return bs
     return DBStore(str(tmp_path / "osd.db"))
 
 

@@ -63,6 +63,57 @@ def build_two_level_map(n_hosts: int, osds_per_host: int,
     return m
 
 
+def build_hierarchy(fanouts: list[int], type_ids: list[int] | None = None,
+                    weights=None,
+                    alg: int = CRUSH_BUCKET_STRAW2) -> CrushMap:
+    """Uniform tree of arbitrary depth: ``fanouts[l]`` children per
+    bucket at level l; the last fanout counts OSDs per leaf bucket.
+    E.g. [4, 5, 10] = root -> 4 racks -> 5 hosts each -> 10 osds each
+    (1000-OSD depth-4 node path root/rack/host/osd).
+
+    ``weights`` optionally gives per-osd 16.16 weights; bucket weights
+    sum their children (as CrushWrapper keeps them)."""
+    m = CrushMap()
+    depth = len(fanouts)
+    type_ids = type_ids or list(range(depth, 0, -1))
+    n_osds = 1
+    for f in fanouts:
+        n_osds *= f
+    weights = weights or [0x10000] * n_osds
+    next_id = [ROOT_ID]
+
+    def build(level: int, osd_base: int) -> tuple[int, int]:
+        """Returns (bucket_id_or_osd, weight)."""
+        span = 1
+        for f in fanouts[level:]:
+            span *= f
+        bid = next_id[0]
+        next_id[0] -= 1
+        items, iw = [], []
+        for c in range(fanouts[level]):
+            if level == depth - 1:
+                osd = osd_base + c
+                items.append(osd)
+                iw.append(weights[osd])
+            else:
+                sub, subw = build(level + 1,
+                                  osd_base + c * (span // fanouts[level]))
+                items.append(sub)
+                iw.append(subw)
+        b = Bucket(id=bid, type=type_ids[level], alg=alg,
+                   items=items, item_weights=iw)
+        m.add_bucket(b, f"b{level}.{bid}")
+        return bid, sum(iw)
+
+    build(0, 0)
+    leaf_type = type_ids[-1]
+    m.add_rule(replicated_rule(0, ROOT_ID, choose_type=leaf_type,
+                               leaf=True))
+    m.add_rule(erasure_rule(1, ROOT_ID, choose_type=leaf_type,
+                            leaf=True))
+    return m
+
+
 def replicated_rule(rule_id: int, root: int, choose_type: int,
                     leaf: bool) -> Rule:
     op = CRUSH_RULE_CHOOSELEAF_FIRSTN if leaf else CRUSH_RULE_CHOOSE_FIRSTN

@@ -189,14 +189,28 @@ def _generate_exponential_distribution(hash_type: int, x: int, y: int, z: int,
     return _div64_s64(ln, weight)
 
 
-def _bucket_straw2_choose(bucket: Bucket, x: int, r: int) -> int:
+def _choose_arg_weights(bucket: Bucket, arg: dict | None,
+                        position: int) -> list[int]:
+    """mapper.c:289 get_choose_arg_weights: the per-position weight
+    set (balancer override) or the bucket's own weights."""
+    if not arg or not arg.get("weight_set"):
+        return bucket.item_weights
+    ws = arg["weight_set"]
+    return ws[min(position, len(ws) - 1)]
+
+
+def _bucket_straw2_choose(bucket: Bucket, x: int, r: int,
+                          arg: dict | None = None,
+                          position: int = 0) -> int:
+    weights = _choose_arg_weights(bucket, arg, position)
+    ids = (arg.get("ids") if arg else None) or bucket.items
     high = 0
     high_draw = 0
     for i in range(bucket.size):
-        w = bucket.item_weights[i]
+        w = weights[i]
         if w:
             draw = _generate_exponential_distribution(
-                bucket.hash, x, bucket.items[i], r, w)
+                bucket.hash, x, ids[i], r, w)
         else:
             draw = S64_MIN
         if i == 0 or draw > high_draw:
@@ -205,7 +219,9 @@ def _bucket_straw2_choose(bucket: Bucket, x: int, r: int) -> int:
     return bucket.items[high]
 
 
-def _crush_bucket_choose(bucket: Bucket, work: _WorkBucket, x: int, r: int) -> int:
+def _crush_bucket_choose(bucket: Bucket, work: _WorkBucket, x: int, r: int,
+                         arg: dict | None = None,
+                         position: int = 0) -> int:
     if bucket.size == 0:
         raise AssertionError("empty bucket")
     if bucket.alg == CRUSH_BUCKET_UNIFORM:
@@ -217,7 +233,7 @@ def _crush_bucket_choose(bucket: Bucket, work: _WorkBucket, x: int, r: int) -> i
     if bucket.alg == CRUSH_BUCKET_STRAW:
         return _bucket_straw_choose(bucket, x, r)
     if bucket.alg == CRUSH_BUCKET_STRAW2:
-        return _bucket_straw2_choose(bucket, x, r)
+        return _bucket_straw2_choose(bucket, x, r, arg, position)
     return bucket.items[0]
 
 
@@ -239,6 +255,7 @@ def _choose_firstn(
     tries: int, recurse_tries: int, local_retries: int,
     local_fallback_retries: int, recurse_to_leaf: bool,
     vary_r: int, stable: int, out2: list[int] | None, parent_r: int,
+    choose_args: dict | None = None,
 ) -> int:
     count = out_size
     rep = 0 if stable else outpos
@@ -264,7 +281,9 @@ def _choose_firstn(
                             in_bucket, work.work[in_bucket.id], x, r)
                     else:
                         item = _crush_bucket_choose(
-                            in_bucket, work.work[in_bucket.id], x, r)
+                            in_bucket, work.work[in_bucket.id], x, r,
+                            choose_args.get(in_bucket.id)
+                            if choose_args else None, outpos)
                     if item >= crush_map.max_devices:
                         skip_rep = True
                         break
@@ -291,6 +310,7 @@ def _choose_firstn(
                                 recurse_tries, 0, local_retries,
                                 local_fallback_retries, False,
                                 vary_r, stable, None, sub_r,
+                                choose_args,
                             ) <= outpos:
                                 reject = True
                         else:
@@ -329,6 +349,7 @@ def _choose_indep(
     weights: list[int], x: int, left: int, numrep: int, choose_type: int,
     out: list[int], outpos: int, tries: int, recurse_tries: int,
     recurse_to_leaf: bool, out2: list[int] | None, parent_r: int,
+    choose_args: dict | None = None,
 ) -> None:
     endpos = outpos + left
     for rep in range(outpos, endpos):
@@ -351,7 +372,9 @@ def _choose_indep(
                 if in_bucket.size == 0:
                     break
                 item = _crush_bucket_choose(
-                    in_bucket, work.work[in_bucket.id], x, r)
+                    in_bucket, work.work[in_bucket.id], x, r,
+                    choose_args.get(in_bucket.id)
+                    if choose_args else None, outpos)
                 if item >= crush_map.max_devices:
                     out[rep] = CRUSH_ITEM_NONE
                     if out2 is not None:
@@ -380,7 +403,8 @@ def _choose_indep(
                         _choose_indep(
                             crush_map, work, crush_map.buckets[item],
                             weights, x, 1, numrep, 0,
-                            out2, rep, recurse_tries, 0, False, None, r)
+                            out2, rep, recurse_tries, 0, False, None, r,
+                            choose_args)
                         if out2 is not None and out2[rep] == CRUSH_ITEM_NONE:
                             break
                     elif out2 is not None:
@@ -400,9 +424,16 @@ def _choose_indep(
 
 def crush_do_rule(
     crush_map: CrushMap, ruleno: int, x: int, result_max: int,
-    weights: list[int],
+    weights: list[int], choose_args: dict | None = None,
 ) -> list[int]:
-    """Run a rule; returns the mapped item vector (may contain NONE holes)."""
+    """Run a rule; returns the mapped item vector (may contain NONE holes).
+
+    ``choose_args`` (bucket id -> {"weight_set", "ids"}) overrides
+    straw2 draw weights per output position -- the balancer's
+    crush-compat weight-set mechanism (mapper.c crush_do_rule's
+    choose_args parameter).  Defaults to the map's own choose_args."""
+    if choose_args is None:
+        choose_args = getattr(crush_map, "choose_args", None) or None
     rule = crush_map.rules.get(ruleno)
     if rule is None:
         return []
@@ -480,7 +511,8 @@ def crush_do_rule(
                         seg, 0, result_max - osize,
                         choose_tries, recurse_tries,
                         choose_local_retries, choose_local_fallback_retries,
-                        recurse_to_leaf, vary_r, stable, cseg, 0)
+                        recurse_to_leaf, vary_r, stable, cseg, 0,
+                        choose_args)
                     o[osize:osize + n] = seg[:n]
                     c[osize:osize + n] = cseg[:n]
                     osize += n
@@ -490,7 +522,7 @@ def crush_do_rule(
                         crush_map, work, bucket, weights, x, out_size,
                         numrep, step.arg2, seg, 0, choose_tries,
                         choose_leaf_tries if choose_leaf_tries else 1,
-                        recurse_to_leaf, cseg, 0)
+                        recurse_to_leaf, cseg, 0, choose_args)
                     o[osize:osize + out_size] = seg[:out_size]
                     c[osize:osize + out_size] = cseg[:out_size]
                     osize += out_size

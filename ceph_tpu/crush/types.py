@@ -98,6 +98,12 @@ class CrushMap:
                                            10: "root"}
         self.bucket_names: dict[int, str] = {}
         self.device_classes: dict[int, str] = {}
+        # choose_args (CrushWrapper.h choose_args_map_t): bucket id ->
+        # {"weight_set": [[w per item] per position], "ids": [...]}.
+        # The balancer's crush-compat mode steers placement by writing
+        # position-specific weight overrides here instead of touching
+        # the real hierarchy weights (mapper.c:289-306).
+        self.choose_args: dict[int, dict] = {}
 
     def add_bucket(self, bucket: Bucket, name: str | None = None) -> None:
         assert bucket.id < 0, "bucket ids are negative"
@@ -113,6 +119,32 @@ class CrushMap:
 
     def bucket(self, item_id: int) -> Bucket | None:
         return self.buckets.get(item_id)
+
+    def create_choose_args(self, positions: int) -> None:
+        """Seed a weight-set for every straw2 bucket with its current
+        weights at every position (CrushWrapper::create_choose_args) --
+        the starting point the balancer then adjusts."""
+        for bid, b in self.buckets.items():
+            self.choose_args[bid] = {
+                "weight_set": [list(b.item_weights)
+                               for _ in range(positions)]}
+
+    def choose_args_adjust_item_weight(self, item: int,
+                                       weight: int | list[int]) -> None:
+        """Set ``item``'s weight-set weight in every bucket that holds
+        it, one value per position (CrushWrapper::
+        choose_args_adjust_item_weight)."""
+        for bid, b in self.buckets.items():
+            if item not in b.items:
+                continue
+            arg = self.choose_args.get(bid)
+            if arg is None:
+                continue
+            i = b.items.index(item)
+            ws = arg["weight_set"]
+            for pos, row in enumerate(ws):
+                row[i] = (weight[min(pos, len(weight) - 1)]
+                          if isinstance(weight, list) else weight)
 
     def name_to_id(self, name: str) -> int | None:
         for bid, n in self.bucket_names.items():

@@ -8,10 +8,12 @@ log tables plus an argmax, and the firstn/indep retry loops become bounded
 `lax.while_loop`s with per-lane masks -- decision-identical to the scalar
 mapper (ceph_tpu/crush/mapper.py), which is itself pinned to mapper.c.
 
-Supported map shape for the fused path: straw2 hierarchies of depth 1
-(root->osds) or 2 (root->hosts->osds) with the standard replicated
-(chooseleaf firstn) / erasure (chooseleaf indep) rules and jewel tunables.
-Anything else falls back to the scalar engine.
+Supported map shape for the fused path: uniform-depth straw2
+hierarchies of ANY depth (root->osds up through root->row->rack->host->
+osd and deeper) with the standard replicated (chooseleaf firstn) /
+erasure (chooseleaf indep) rules, jewel tunables, and optional
+choose_args weight-sets (the balancer's crush-compat overrides,
+mapper.c:289-306).  Anything else falls back to the scalar engine.
 """
 
 from __future__ import annotations
@@ -138,42 +140,99 @@ def is_out_jnp(osd_weights, item, x):
 
 @dataclass
 class CompiledMap:
-    """Flattened straw2 hierarchy for the fused path."""
+    """Flattened uniform-depth straw2 hierarchy for the fused path.
 
-    depth: int                      # 1 or 2
-    host_ids: np.ndarray            # (H,) int32 bucket ids (depth2) / osd ids
-    host_weights: np.ndarray        # (H,) int32 16.16
-    leaf_items: np.ndarray | None   # (H, max_per_host) int32, -pad
-    leaf_weights: np.ndarray | None
+    Level l holds every bucket at distance l from the take root as
+    padded tables; the choose phase descends them in lockstep (one
+    straw2 draw + argmax per level per lane), exactly the recursive
+    descent of mapper.c crush_choose_firstn/indep, but data-parallel
+    over the lane axis.  Arbitrary depth (root->rack->host->osd and
+    deeper) compiles; non-uniform leaf depth or non-straw2 buckets
+    fall back to the scalar engine.
+
+    child_ids carry the CRUSH item ids (what straw2 hashes);
+    child_idx carry the row index into the NEXT level's tables (or the
+    osd id at the last level).  weights are the bucket item weights,
+    0-padded; cw holds the choose_args weight-set override per output
+    position when the map has one (mapper.c get_choose_arg_weights).
+    """
+
+    n_levels: int                       # bucket levels (root = level 0)
+    child_ids: list                     # [(B_l, N_l) int32]
+    child_idx: list                     # [(B_l, N_l) int32]
+    weights: list                       # [(B_l, N_l) int32]
+    cw: list | None                     # [(P, B_l, N_l)] or None
+    bucket_ids: list                    # [(B_l,) int32] crush ids per level
     max_devices: int
+    leaf_parent_types: frozenset = frozenset()
 
     @classmethod
-    def from_map(cls, crush_map: CrushMap, root_id: int) -> "CompiledMap":
-        root = crush_map.buckets[root_id]
-        if root.alg != CRUSH_BUCKET_STRAW2:
-            raise ValueError("fused path requires straw2 buckets")
-        children = [crush_map.buckets.get(i) for i in root.items]
-        if all(c is None for c in children):
-            return cls(1, np.asarray(root.items, np.int32),
-                       np.asarray(root.item_weights, np.int32),
-                       None, None, crush_map.max_devices)
-        if any(c is None for c in children):
-            raise ValueError("mixed osd/bucket children unsupported")
-        for c in children:
-            if c.alg != CRUSH_BUCKET_STRAW2:
-                raise ValueError("fused path requires straw2 buckets")
-            if any(i < 0 for i in c.items):
-                raise ValueError("fused path supports depth <= 2")
-        maxn = max(c.size for c in children)
-        li = np.zeros((len(children), maxn), np.int32)
-        lw = np.zeros((len(children), maxn), np.int32)
-        for j, c in enumerate(children):
-            li[j, :c.size] = c.items
-            li[j, c.size:] = c.items[0] if c.items else 0
-            lw[j, :c.size] = c.item_weights
-        return cls(2, np.asarray(root.items, np.int32),
-                   np.asarray(root.item_weights, np.int32),
-                   li, lw, crush_map.max_devices)
+    def from_map(cls, crush_map: CrushMap, root_id: int,
+                 choose_args: dict | None = None) -> "CompiledMap":
+        levels: list[list] = [[crush_map.buckets[root_id]]]
+        while True:
+            cur = levels[-1]
+            kinds = set()
+            for b in cur:
+                if b.alg != CRUSH_BUCKET_STRAW2:
+                    raise ValueError("fused path requires straw2")
+                for i in b.items:
+                    kinds.add(i < 0)
+            if kinds == {True}:
+                levels.append([crush_map.buckets.get(i)
+                               for b in cur for i in b.items])
+                if any(b is None for b in levels[-1]):
+                    raise ValueError("dangling bucket reference")
+            elif kinds == {False}:
+                break                   # this level's items are osds
+            else:
+                raise ValueError("mixed osd/bucket children "
+                                 "unsupported by the fused path")
+        # dense row index per bucket id per level
+        idx_of = [{b.id: j for j, b in enumerate(lv)} for lv in levels]
+        child_ids, child_idx, weights, cw, bids = [], [], [], [], []
+        ca = choose_args if choose_args is not None else \
+            getattr(crush_map, "choose_args", None)
+        positions = 1
+        if ca:
+            for arg in ca.values():
+                if arg.get("weight_set"):
+                    positions = max(positions, len(arg["weight_set"]))
+        for l, lv in enumerate(levels):
+            maxn = max(b.size for b in lv)
+            ids = np.zeros((len(lv), maxn), np.int32)
+            idx = np.zeros((len(lv), maxn), np.int32)
+            w = np.zeros((len(lv), maxn), np.int32)
+            cwl = np.zeros((positions, len(lv), maxn), np.int32)
+            for j, b in enumerate(lv):
+                arg = (ca or {}).get(b.id) or {}
+                hash_ids = arg.get("ids") or b.items
+                ids[j, :b.size] = hash_ids
+                ids[j, b.size:] = hash_ids[0] if b.size else 0
+                w[j, :b.size] = b.item_weights
+                ws = arg.get("weight_set")
+                for pos in range(positions):
+                    src = (ws[min(pos, len(ws) - 1)] if ws
+                           else b.item_weights)
+                    cwl[pos, j, :b.size] = src
+                if l + 1 < len(levels):
+                    idx[j, :b.size] = [idx_of[l + 1][i]
+                                       for i in b.items]
+                    idx[j, b.size:] = idx[j, 0] if b.size else 0
+                else:
+                    idx[j, :b.size] = b.items
+                    idx[j, b.size:] = b.items[0] if b.size else 0
+            child_ids.append(ids)
+            child_idx.append(idx)
+            weights.append(w)
+            cw.append(cwl)
+            bids.append(np.asarray([b.id for b in lv], np.int32))
+        has_ca = bool(ca) and any(
+            a.get("weight_set") or a.get("ids") for a in ca.values())
+        return cls(len(levels), child_ids, child_idx, weights,
+                   cw if has_ca else None, bids,
+                   crush_map.max_devices,
+                   frozenset(b.type for b in levels[-1]))
 
 
 def _rule_shape(crush_map: CrushMap, ruleno: int):
@@ -184,6 +243,7 @@ def _rule_shape(crush_map: CrushMap, ruleno: int):
     leaf_tries = 0
     root_id = None
     mode = None
+    choose_type = 0
     for step in rule.steps:
         if step.op == CRUSH_RULE_SET_CHOOSE_TRIES:
             choose_tries = step.arg1
@@ -194,24 +254,33 @@ def _rule_shape(crush_map: CrushMap, ruleno: int):
         elif step.op in (CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSELEAF_FIRSTN,
                          CRUSH_RULE_CHOOSE_INDEP, CRUSH_RULE_CHOOSELEAF_INDEP):
             mode = step.op
-        elif step.op == CRUSH_RULE_EMIT:
-            pass
+            choose_type = step.arg2
     firstn = mode in (CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSELEAF_FIRSTN)
     leaf = mode in (CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP)
-    return root_id, firstn, leaf, choose_tries, leaf_tries
+    return root_id, firstn, leaf, choose_tries, leaf_tries, choose_type
 
 
 class VectorCrush:
-    """Bulk mapper for one (map, rule) pair."""
+    """Bulk mapper for one (map, rule) pair, any uniform depth."""
 
-    def __init__(self, crush_map: CrushMap, ruleno: int) -> None:
-        root_id, firstn, leaf, choose_tries, leaf_tries = _rule_shape(
-            crush_map, ruleno)
-        self.cm = CompiledMap.from_map(crush_map, root_id)
-        if leaf and self.cm.depth != 2:
-            raise ValueError("chooseleaf rule needs a depth-2 map")
-        if not leaf and self.cm.depth != 1:
-            raise ValueError("plain choose rule needs a depth-1 map")
+    def __init__(self, crush_map: CrushMap, ruleno: int,
+                 choose_args: dict | None = None) -> None:
+        (root_id, firstn, leaf, choose_tries, leaf_tries,
+         choose_type) = _rule_shape(crush_map, ruleno)
+        self.cm = CompiledMap.from_map(crush_map, root_id, choose_args)
+        # chooseleaf picks buckets at the LAST bucket level then
+        # recurses to an osd; plain choose must name the device level
+        self.leaf = leaf
+        if leaf:
+            # only the tree under THIS rule's take root matters: a
+            # second hierarchy's leaf parents must not veto the map
+            if self.cm.leaf_parent_types != {choose_type}:
+                raise ValueError(
+                    "chooseleaf type must be the osd-parent level for "
+                    "the fused path")
+        elif choose_type != 0:
+            raise ValueError("plain choose of a bucket type needs the "
+                             "scalar engine")
         t = crush_map.tunables
         self.firstn = firstn
         self.choose_tries = choose_tries
@@ -229,64 +298,125 @@ class VectorCrush:
             # scalar fallback covers other tunable profiles
             raise ValueError("fused path implements jewel tunables")
 
+    def _tables(self):
+        cm = self.cm
+        ids = [jnp.asarray(t) for t in cm.child_ids]
+        idx = [jnp.asarray(t) for t in cm.child_idx]
+        if cm.cw is not None:
+            w = [jnp.asarray(t) for t in cm.cw]      # (P, B, N)
+        else:
+            w = [jnp.asarray(t)[None] for t in cm.weights]
+        return ids, idx, w
+
+    def _descend(self, ids, idx, w, xs, r, rep, upto: int):
+        """Lockstep descent: levels 0..upto-1, one draw per level.
+        Returns row indices into level ``upto``'s tables (or osd ids
+        when upto == n_levels)."""
+        L = xs.shape[0]
+        cur = jnp.zeros((L,), jnp.int32)
+        for l in range(upto):
+            wl = w[l]
+            pos = min(rep, wl.shape[0] - 1)
+            draws = straw2_draws(xs, ids[l][cur], r, wl[pos][cur])
+            j = jnp.argmax(draws, axis=-1)
+            cur = idx[l][cur, j]
+        return cur
+
+    def _leaf_descend(self, ids, idx, w, xs, host_idx, sub_r, rep,
+                      numrep, osd_weights, taken):
+        """chooseleaf recursion into the chosen last-level bucket:
+        up to recurse_tries draws, rejecting out osds and (firstn)
+        collisions with already-placed osds."""
+        lvl = self.cm.n_levels - 1
+        L = xs.shape[0]
+        wl = w[lvl]
+        pos = min(rep, wl.shape[0] - 1)
+
+        def cond(st):
+            ft, found, _ = st
+            # one shared try counter: a still-searching lane's personal
+            # ftotal equals the iteration count (it either found and
+            # froze, or rejected every round so far)
+            return jnp.any(~found) & (ft < self.recurse_tries)
+
+        def body(st):
+            ft, found, osd = st
+            if self.firstn:
+                # leaf recursion: numrep=1, rep'=0 (stable), so
+                # r_leaf = sub_r + ftotal_leaf
+                r_leaf = (sub_r + ft).astype(jnp.int32)
+            else:
+                r_leaf = (rep + sub_r + numrep * ft).astype(jnp.int32)
+            draws = straw2_draws(xs, ids[lvl][host_idx], r_leaf,
+                                 wl[pos][host_idx])
+            j = jnp.argmax(draws, axis=-1)
+            cand = idx[lvl][host_idx, j]
+            bad = is_out_jnp(osd_weights, cand, xs)
+            if taken is not None:
+                for t in taken:
+                    bad |= t == cand
+            ok = ~found & ~bad
+            osd = jnp.where(ok, cand, osd)
+            return ft + 1, found | ok, osd
+
+        init = (jnp.int32(0), jnp.zeros((L,), bool),
+                jnp.full((L,), CRUSH_ITEM_NONE, jnp.int32))
+        _, found, osd = jax.lax.while_loop(cond, body, init)
+        return osd, found
+
     # -- firstn -------------------------------------------------------------
     @partial(jax.jit, static_argnames=("self", "numrep"))
     def map_firstn(self, xs: jnp.ndarray, numrep: int,
                    osd_weights: jnp.ndarray) -> jnp.ndarray:
-        """xs: (L,) int32 placement seeds -> (L, numrep) osd ids (or NONE)."""
         cm = self.cm
+        ids, idx, w = self._tables()
         L = xs.shape[0]
-        host_ids = jnp.asarray(cm.host_ids)
-        host_w = jnp.asarray(cm.host_weights)
+        # chooseleaf targets the last bucket level; plain choose (no
+        # leaf recursion) targets the device level
+        bucket_levels = cm.n_levels - 1 if self.leaf else cm.n_levels
         out = jnp.full((L, numrep), CRUSH_ITEM_NONE, jnp.int32)
-        out_hosts = jnp.full((L, numrep), jnp.int32(2**31 - 1), jnp.int32)
-
-        def pick_leaf(x, host_idx, r):
-            if cm.depth == 1:
-                osd = host_ids[host_idx]
-                return osd
-            litems = jnp.asarray(cm.leaf_items)[host_idx]
-            lw = jnp.asarray(cm.leaf_weights)[host_idx]
-            draws = straw2_draws(x, litems, r, lw)
-            return litems[jnp.arange(L), jnp.argmax(draws, axis=-1)]
+        out_sel = jnp.full((L, numrep), jnp.int32(2**31 - 1), jnp.int32)
 
         for rep in range(numrep):
-            # per-lane retry loop: state = (ftotal, done, host_idx, osd)
             def cond(state):
                 ftotal, done, _, _ = state
                 return jnp.any(~done & (ftotal < self.choose_tries))
 
             def body(state):
-                ftotal, done, host_idx, osd = state
+                ftotal, done, sel, osd = state
                 r = (rep + ftotal).astype(jnp.int32)
-                draws = straw2_draws(
-                    xs, jnp.broadcast_to(host_ids, (L, host_ids.shape[0])),
-                    r, jnp.broadcast_to(host_w, (L, host_w.shape[0])))
-                cand_idx = jnp.argmax(draws, axis=-1).astype(jnp.int32)
-                # collision vs previously placed hosts in this take block
+                cand_sel = self._descend(ids, idx, w, xs, r, rep,
+                                         bucket_levels)
                 collide = jnp.zeros((L,), bool)
                 for j in range(rep):
-                    collide |= out_hosts[:, j] == cand_idx
-                # descend to leaf: sub_r = r >> (vary_r - 1) = r
-                cand_osd = pick_leaf(xs, cand_idx, r)
-                reject = is_out_jnp(osd_weights, cand_osd, xs)
-                if cm.depth == 2:
+                    collide |= out_sel[:, j] == cand_sel
+                if self.leaf:
+                    # vary_r=1: sub_r = r >> 0 = r
+                    cand_osd, found = self._leaf_descend(
+                        ids, idx, w, xs, cand_sel, r, rep, numrep,
+                        osd_weights,
+                        [out[:, j] for j in range(rep)])
+                    reject = ~found
+                else:
+                    cand_osd = cand_sel
+                    reject = is_out_jnp(osd_weights, cand_osd, xs)
                     for j in range(rep):
                         reject |= out[:, j] == cand_osd
                 ok = ~done & ~collide & ~reject
-                host_idx = jnp.where(ok, cand_idx, host_idx)
+                sel = jnp.where(ok, cand_sel, sel)
                 osd = jnp.where(ok, cand_osd, osd)
                 newdone = done | ok
                 ftotal = jnp.where(~newdone, ftotal + 1, ftotal)
-                return ftotal, newdone, host_idx, osd
+                return ftotal, newdone, sel, osd
 
             init = (jnp.zeros((L,), jnp.int32), jnp.zeros((L,), bool),
                     jnp.full((L,), 2**31 - 1, jnp.int32),
                     jnp.full((L,), CRUSH_ITEM_NONE, jnp.int32))
-            ftotal, done, host_idx, osd = jax.lax.while_loop(cond, body, init)
-            out = out.at[:, rep].set(jnp.where(done, osd, CRUSH_ITEM_NONE))
-            out_hosts = out_hosts.at[:, rep].set(
-                jnp.where(done, host_idx, 2**31 - 1))
+            ftotal, done, sel, osd = jax.lax.while_loop(cond, body, init)
+            out = out.at[:, rep].set(
+                jnp.where(done, osd, CRUSH_ITEM_NONE))
+            out_sel = out_sel.at[:, rep].set(
+                jnp.where(done, sel, 2**31 - 1))
         return out
 
     # -- indep --------------------------------------------------------------
@@ -294,25 +424,10 @@ class VectorCrush:
     def map_indep(self, xs: jnp.ndarray, numrep: int,
                   osd_weights: jnp.ndarray) -> jnp.ndarray:
         cm = self.cm
+        ids, idx, w = self._tables()
         L = xs.shape[0]
-        host_ids = jnp.asarray(cm.host_ids)
-        host_w = jnp.asarray(cm.host_weights)
         UNDEF = jnp.int32(0x7FFFFFFE)
-
-        def leaf_try(x, host_idx, parent_r, rep):
-            """indep recursion: up to recurse_tries rounds for one slot."""
-            litems = jnp.asarray(cm.leaf_items)[host_idx]
-            lw = jnp.asarray(cm.leaf_weights)[host_idx]
-            osd = jnp.full((L,), CRUSH_ITEM_NONE, jnp.int32)
-            found = jnp.zeros((L,), bool)
-            for ft in range(self.recurse_tries):
-                r_leaf = (rep + parent_r + numrep * ft).astype(jnp.int32)
-                draws = straw2_draws(x, litems, r_leaf, lw)
-                cand = litems[jnp.arange(L), jnp.argmax(draws, axis=-1)]
-                ok = ~found & ~is_out_jnp(osd_weights, cand, x)
-                osd = jnp.where(ok, cand, osd)
-                found |= ok
-            return osd, found
+        bucket_levels = cm.n_levels - 1 if self.leaf else cm.n_levels
 
         def cond(state):
             ftotal, out_h, out_o = state
@@ -323,24 +438,25 @@ class VectorCrush:
             for rep in range(numrep):
                 slot_undef = out_h[:, rep] == UNDEF
                 r = (rep + numrep * ftotal).astype(jnp.int32)
-                draws = straw2_draws(
-                    xs, jnp.broadcast_to(host_ids, (L, host_ids.shape[0])),
-                    r, jnp.broadcast_to(host_w, (L, host_w.shape[0])))
-                cand_idx = jnp.argmax(draws, axis=-1).astype(jnp.int32)
-                if cm.depth == 1:
-                    # flat: slots hold osd ids; compare apples to apples
-                    cand_idx = host_ids[cand_idx]
+                # weight-set position is the top call's OUTPOS (0),
+                # not the replica slot (crush_choose_indep passes its
+                # own outpos down); the leaf recursion's outpos IS the
+                # slot, so _leaf_descend keeps rep
+                cand_sel = self._descend(ids, idx, w, xs, r, 0,
+                                         bucket_levels)
                 collide = jnp.zeros((L,), bool)
                 for j in range(numrep):
-                    collide |= out_h[:, j] == cand_idx
-                if cm.depth == 2:
-                    osd, found = leaf_try(xs, cand_idx, r, rep)
+                    collide |= out_h[:, j] == cand_sel
+                if self.leaf:
+                    osd, found = self._leaf_descend(
+                        ids, idx, w, xs, cand_sel, r, rep, numrep,
+                        osd_weights, None)
                 else:
-                    osd = cand_idx
+                    osd = cand_sel
                     found = ~is_out_jnp(osd_weights, osd, xs)
                 ok = slot_undef & ~collide & found
                 out_h = out_h.at[:, rep].set(
-                    jnp.where(ok, cand_idx, out_h[:, rep]))
+                    jnp.where(ok, cand_sel, out_h[:, rep]))
                 out_o = out_o.at[:, rep].set(
                     jnp.where(ok, osd, out_o[:, rep]))
             return ftotal + 1, out_h, out_o

@@ -35,12 +35,20 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from ..crush import crush_do_rule
-    from ..crush.builder import build_two_level_map
+    from ..crush.builder import build_hierarchy
     from ..crush.vectorized import VectorCrush
 
+    # depth-4 (root->row->rack->host->osd), the realistic shape the
+    # balancer chews on: 5 rows x 5 racks x 4 hosts x 10 osds = 1000
     osds_per_host = 10
-    n_hosts = args.osds // osds_per_host
-    cm = build_two_level_map(n_hosts, osds_per_host)
+    hosts = max(1, args.osds // osds_per_host)
+    racks = max(1, hosts // 4)
+    rows = max(1, racks // 5)
+    cm = build_hierarchy([rows, max(1, racks // rows),
+                          max(1, hosts // racks), osds_per_host])
+    n = rows * max(1, racks // rows) * max(1, hosts // racks) \
+        * osds_per_host
+    args.osds = n
     ruleno = 0                       # replicated chooseleaf firstn
     weights = [0x10000] * args.osds
     vc = VectorCrush(cm, ruleno)
@@ -87,7 +95,7 @@ def main(argv=None) -> int:
         "value": round(rate, 1),
         "unit": "pg/s",
         "n_mappings": total,
-        "n_osds": args.osds,
+        "n_osds": args.osds, "depth": 4,
         "replicas": args.replicas,
         "batch": batch,
         "launches": n_batches,

@@ -63,3 +63,29 @@ def test_all_three_agree_vectorized_shape():
             oracle = crush_oracle_do_rule(cm, ruleno, int(x), 3, w)
             assert oracle == scalar, (ruleno, int(x))
             assert list(vec[lane]) == scalar, (ruleno, int(x), lane)
+
+
+@pytest.mark.parametrize("ruleno", [0, 1], ids=["firstn", "indep"])
+def test_all_three_agree_depth4(ruleno):
+    """Randomized depth-4 maps (root->row->rack->host->osd): C oracle,
+    scalar engine and the fused vectorized mapper agree lane-exact."""
+    from ceph_tpu.crush.builder import build_hierarchy
+    from ceph_tpu.crush.vectorized import VectorCrush
+
+    rng = np.random.default_rng(61 + ruleno)
+    for trial in range(3):
+        fan = [int(rng.integers(2, 4)), int(rng.integers(2, 4)),
+               int(rng.integers(2, 4)), int(rng.integers(2, 6))]
+        cm = build_hierarchy(fan)
+        n = fan[0] * fan[1] * fan[2] * fan[3]
+        w = [0x10000] * n
+        for i in rng.integers(0, n, size=max(1, n // 5)):
+            w[int(i)] = int(rng.choice([0, 0x4000, 0x8000]))
+        xs = rng.integers(0, 2**31 - 1, size=128).astype(np.int64)
+        vc = VectorCrush(cm, ruleno)
+        vec = vc.map_pgs(xs, 3, w)
+        for i, x in enumerate(xs):
+            want = crush_do_rule(cm, ruleno, int(x), 3, w)
+            oracle = crush_oracle_do_rule(cm, ruleno, int(x), 3, w)
+            assert oracle == want, (trial, i, want, oracle)
+            assert list(vec[i]) == want, (trial, i, want, list(vec[i]))

@@ -94,3 +94,74 @@ def test_weighted_hosts_match_scalar():
     got = vc.map_pgs(xs, 2, weights)
     want = scalar_batch(m, 0, xs, 2, weights)
     assert np.array_equal(got, want)
+
+
+def test_depth4_firstn_and_indep_lane_exact():
+    """Arbitrary-depth descent (root->row->rack->host->osd): the fused
+    engine must match the scalar mapper lane-for-lane on randomized
+    deep maps with reweighted/out OSDs (the balancer's real map shape,
+    mapper.c:441-825)."""
+    from ceph_tpu.crush.builder import build_hierarchy
+    from ceph_tpu.crush.vectorized import VectorCrush
+    from ceph_tpu.crush import crush_do_rule
+
+    rng = np.random.default_rng(5)
+    cm = build_hierarchy([3, 4, 5, 4])       # 240 osds, 4 levels
+    n = 240
+    weights = [int(w) for w in rng.choice(
+        [0, 0x8000, 0xc000, 0x10000], size=n, p=[.05, .1, .15, .7])]
+    xs = rng.integers(0, 2**31 - 1, size=200, dtype=np.int64)
+    for ruleno in (0, 1):
+        vc = VectorCrush(cm, ruleno)
+        assert vc.cm.n_levels == 4
+        got = vc.map_pgs(xs, 3, weights)
+        for i, x in enumerate(xs):
+            want = crush_do_rule(cm, ruleno, int(x), 3, weights)
+            assert list(got[i]) == list(want), (ruleno, i)
+
+
+def test_choose_args_weight_set_scalar_and_vector():
+    """choose_args weight-sets (mapper.c:289 get_choose_arg_weights):
+    a per-position weight override must steer placement identically in
+    the scalar and fused engines, and differently from the base map."""
+    from ceph_tpu.crush.builder import build_hierarchy
+    from ceph_tpu.crush.vectorized import VectorCrush
+    from ceph_tpu.crush import crush_do_rule
+
+    rng = np.random.default_rng(7)
+    cm = build_hierarchy([4, 4, 4])          # 64 osds, 3 levels
+    weights = [0x10000] * 64
+    xs = rng.integers(0, 2**31 - 1, size=200, dtype=np.int64)
+
+    base = [list(crush_do_rule(cm, 0, int(x), 3, weights)) for x in xs]
+    # the balancer zeroes the first rack for position 0 and doubles
+    # the last for later positions
+    cm.choose_args = {-1: {"weight_set": [
+        [0, 0x40000, 0x40000, 0x40000],
+        [0x40000, 0x40000, 0x40000, 0x80000],
+    ]}}
+    steered = [list(crush_do_rule(cm, 0, int(x), 3, weights))
+               for x in xs]
+    assert steered != base, "weight-set had no effect"
+    # position-0 never lands in the zeroed first rack (osds 0..15)
+    assert all(s[0] >= 16 for s in steered)
+
+    vc = VectorCrush(cm, 0)
+    got = vc.map_pgs(xs, 3, weights)
+    for i in range(len(xs)):
+        assert list(got[i]) == steered[i], (i, list(got[i]), steered[i])
+
+    # explicit override parameter beats the map's own choose_args
+    plain = [list(crush_do_rule(cm, 0, int(x), 3, weights,
+                                choose_args={})) for x in xs]
+    assert plain == base
+
+    # indep (erasure) rules: the weight-set position is the top-level
+    # OUTPOS (0), not the replica slot -- lane-exact there too
+    steered_i = [list(crush_do_rule(cm, 1, int(x), 3, weights))
+                 for x in xs]
+    vci = VectorCrush(cm, 1)
+    goti = vci.map_pgs(xs, 3, weights)
+    for i in range(len(xs)):
+        assert list(goti[i]) == steered_i[i], \
+            (i, list(goti[i]), steered_i[i])

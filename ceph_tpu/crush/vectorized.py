@@ -308,29 +308,31 @@ class VectorCrush:
             w = [jnp.asarray(t)[None] for t in cm.weights]
         return ids, idx, w
 
-    def _descend(self, ids, idx, w, xs, r, rep, upto: int):
+    def _descend(self, ids, idx, w, xs, r, pos, upto: int):
         """Lockstep descent: levels 0..upto-1, one draw per level.
         Returns row indices into level ``upto``'s tables (or osd ids
-        when upto == n_levels)."""
+        when upto == n_levels).  ``pos`` is the choose_args weight-set
+        position -- a scalar, or a PER-LANE vector when lanes have
+        placed different counts (firstn's outpos)."""
         L = xs.shape[0]
         cur = jnp.zeros((L,), jnp.int32)
         for l in range(upto):
             wl = w[l]
-            pos = min(rep, wl.shape[0] - 1)
-            draws = straw2_draws(xs, ids[l][cur], r, wl[pos][cur])
+            p = jnp.clip(jnp.asarray(pos), 0, wl.shape[0] - 1)
+            draws = straw2_draws(xs, ids[l][cur], r, wl[p, cur])
             j = jnp.argmax(draws, axis=-1)
             cur = idx[l][cur, j]
         return cur
 
     def _leaf_descend(self, ids, idx, w, xs, host_idx, sub_r, rep,
-                      numrep, osd_weights, taken):
+                      numrep, osd_weights, taken, pos):
         """chooseleaf recursion into the chosen last-level bucket:
         up to recurse_tries draws, rejecting out osds and (firstn)
         collisions with already-placed osds."""
         lvl = self.cm.n_levels - 1
         L = xs.shape[0]
         wl = w[lvl]
-        pos = min(rep, wl.shape[0] - 1)
+        pos = jnp.clip(jnp.asarray(pos), 0, wl.shape[0] - 1)
 
         def cond(st):
             ft, found, _ = st
@@ -348,7 +350,7 @@ class VectorCrush:
             else:
                 r_leaf = (rep + sub_r + numrep * ft).astype(jnp.int32)
             draws = straw2_draws(xs, ids[lvl][host_idx], r_leaf,
-                                 wl[pos][host_idx])
+                                 wl[pos, host_idx])
             j = jnp.argmax(draws, axis=-1)
             cand = idx[lvl][host_idx, j]
             bad = is_out_jnp(osd_weights, cand, xs)
@@ -376,6 +378,11 @@ class VectorCrush:
         bucket_levels = cm.n_levels - 1 if self.leaf else cm.n_levels
         out = jnp.full((L, numrep), CRUSH_ITEM_NONE, jnp.int32)
         out_sel = jnp.full((L, numrep), jnp.int32(2**31 - 1), jnp.int32)
+        # per-lane count of PLACED replicas: the scalar engine's
+        # outpos, which is the choose_args weight-set position (a lane
+        # whose earlier slot exhausted its tries keeps drawing later
+        # slots at the unadvanced position, exactly as mapper.c does)
+        placed = jnp.zeros((L,), jnp.int32)
 
         for rep in range(numrep):
             def cond(state):
@@ -385,7 +392,7 @@ class VectorCrush:
             def body(state):
                 ftotal, done, sel, osd = state
                 r = (rep + ftotal).astype(jnp.int32)
-                cand_sel = self._descend(ids, idx, w, xs, r, rep,
+                cand_sel = self._descend(ids, idx, w, xs, r, placed,
                                          bucket_levels)
                 collide = jnp.zeros((L,), bool)
                 for j in range(rep):
@@ -395,7 +402,7 @@ class VectorCrush:
                     cand_osd, found = self._leaf_descend(
                         ids, idx, w, xs, cand_sel, r, rep, numrep,
                         osd_weights,
-                        [out[:, j] for j in range(rep)])
+                        [out[:, j] for j in range(rep)], placed)
                     reject = ~found
                 else:
                     cand_osd = cand_sel
@@ -417,7 +424,12 @@ class VectorCrush:
                 jnp.where(done, osd, CRUSH_ITEM_NONE))
             out_sel = out_sel.at[:, rep].set(
                 jnp.where(done, sel, 2**31 - 1))
-        return out
+            placed = placed + done.astype(jnp.int32)
+        # scalar firstn COMPACTS (an exhausted slot leaves no hole):
+        # shift placed entries left, NONE-pad the tail
+        is_none = out == CRUSH_ITEM_NONE
+        order = jnp.argsort(is_none, axis=1, stable=True)
+        return jnp.take_along_axis(out, order, axis=1)
 
     # -- indep --------------------------------------------------------------
     @partial(jax.jit, static_argnames=("self", "numrep"))
@@ -450,7 +462,7 @@ class VectorCrush:
                 if self.leaf:
                     osd, found = self._leaf_descend(
                         ids, idx, w, xs, cand_sel, r, rep, numrep,
-                        osd_weights, None)
+                        osd_weights, None, rep)
                 else:
                     osd = cand_sel
                     found = ~is_out_jnp(osd_weights, osd, xs)

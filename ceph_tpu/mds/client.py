@@ -17,7 +17,7 @@ import json
 from ..client.rados import Rados, RadosError
 from ..client.striper import Layout, RadosStriper
 from ..msg import Message
-from .server import DEFAULT_LAYOUT, MDSMAP_OID
+from .server import CAP_LEASE, DEFAULT_LAYOUT, MDSMAP_OID
 
 
 class FsError(Exception):
@@ -27,10 +27,17 @@ class FsError(Exception):
 
 
 class FsFile:
-    """An open file handle."""
+    """An open file handle holding a capability.
+
+    The cap ("r" or "w") is what makes cached state legal: a "w"
+    holder may buffer its size and append position; when the MDS
+    revokes (another client opened the file), the handle flushes and
+    goes STALE -- the next write re-opens to re-acquire the cap and
+    refresh the size, so two clients cannot clobber each other
+    (Locker.cc cap revocation compressed to the Fr/Fw pair)."""
 
     def __init__(self, fs: "CephFS", path: str, dentry: dict,
-                 append: bool = False) -> None:
+                 append: bool = False, caps: str = "r") -> None:
         self.fs = fs
         self.path = path
         self.dentry = dentry
@@ -40,11 +47,28 @@ class FsFile:
             stripe_unit=lay["su"], stripe_count=lay["sc"],
             object_size=lay["os"]))
         self.size = dentry.get("size", 0)
+        self.caps = caps
+        self._stale = False
         self._append = append
         self._dirty = False
         self._closed = False
+        fs._track_file(self)
+
+    async def _reacquire(self, want: str) -> None:
+        """Cap lost (revoked or lapsed): flush went out at revoke
+        time; re-open to refresh size + regain the cap."""
+        out = await self.fs._request({"op": "open", "path": self.path,
+                                      "want": want})
+        self.dentry = out["dentry"]
+        self.size = self.dentry.get("size", 0)
+        self.caps = out.get("caps", want)
+        self._stale = False
+        self.fs._note_lease()
 
     async def write(self, data: bytes, offset: int | None = None) -> int:
+        if self._stale or "w" not in self.caps \
+                or not self.fs._caps_fresh():
+            await self._reacquire("w")
         # append mode: every write lands at EOF (O_APPEND); otherwise
         # an omitted offset means 0
         offset = self.size if self._append else (offset or 0)
@@ -55,9 +79,14 @@ class FsFile:
 
     async def read(self, length: int | None = None,
                    offset: int = 0) -> bytes:
+        if self._stale:
+            await self._reacquire("r" if "w" not in self.caps else "w")
         return await self.striper.read(f"{self.ino:x}", length, offset)
 
     async def truncate(self, size: int) -> None:
+        if self._stale or "w" not in self.caps \
+                or not self.fs._caps_fresh():
+            await self._reacquire("w")
         await self.striper.truncate(f"{self.ino:x}", size)
         self.size = size
         self._dirty = True
@@ -72,6 +101,12 @@ class FsFile:
         if not self._closed:
             self._closed = True
             await self.fsync()
+            self.fs._untrack_file(self)
+            try:
+                await self.fs._send_to_mds(Message(
+                    "cap_release", {"ino": self.ino}))
+            except (ConnectionError, OSError):
+                pass
 
 
 class CephFS:
@@ -90,6 +125,13 @@ class CephFS:
         self.mds_addr: tuple[str, int] | None = None
         self._tid = itertools.count(1)
         self._waiters: dict[int, asyncio.Future] = {}
+        self._files: dict[int, list[FsFile]] = {}     # ino -> handles
+        self._renew_task: asyncio.Task | None = None
+        # local lease clock: caps are only trusted while a renewal (or
+        # grant) succeeded within the lease -- after a connectivity
+        # gap the MDS may have expired and re-granted them, so the
+        # client must treat its own copies as stale
+        self._lease_valid_until = 0.0
 
     async def mount(self) -> "CephFS":
         await self.rados.connect()
@@ -100,13 +142,92 @@ class CephFS:
         return self
 
     async def unmount(self) -> None:
+        if self._renew_task:
+            self._renew_task.cancel()
         await self.rados.shutdown()
 
+    # -- capability bookkeeping ---------------------------------------------
+    def _track_file(self, f: FsFile) -> None:
+        self._files.setdefault(f.ino, []).append(f)
+        if self._renew_task is None or self._renew_task.done():
+            self._renew_task = asyncio.ensure_future(self._renew_loop())
+
+    def _untrack_file(self, f: FsFile) -> None:
+        handles = self._files.get(f.ino, [])
+        if f in handles:
+            handles.remove(f)
+        if not handles:
+            self._files.pop(f.ino, None)
+
+    async def _send_to_mds(self, msg: Message) -> None:
+        await self.rados.objecter.msgr.send(self.mds_addr, "mds", msg)
+
+    def _caps_fresh(self) -> bool:
+        loop = asyncio.get_event_loop()
+        return loop.time() < self._lease_valid_until
+
+    def _note_lease(self) -> None:
+        self._lease_valid_until = (asyncio.get_event_loop().time()
+                                   + CAP_LEASE)
+
+    async def _renew_loop(self) -> None:
+        """Session heartbeat: keeps held caps alive AND tracks whether
+        they are still trustworthy locally (an unacked lease means the
+        MDS may have expired + re-granted them to someone else)."""
+        try:
+            while self._files:
+                await asyncio.sleep(CAP_LEASE / 3)
+                if not self._files:
+                    return
+                loop = asyncio.get_event_loop()
+                fut = loop.create_future()
+                self._renew_waiter = fut
+                try:
+                    await self._send_to_mds(
+                        Message("session_renew", {}))
+                    await asyncio.wait_for(fut, 2.0)
+                    self._note_lease()
+                except (ConnectionError, OSError,
+                        asyncio.TimeoutError):
+                    pass               # lease clock keeps draining
+                finally:
+                    self._renew_waiter = None
+        except asyncio.CancelledError:
+            pass
+
+    async def _on_cap_revoke(self, msg: Message) -> None:
+        """The MDS wants our cap back: flush every dirty handle on the
+        ino, mark them stale, release."""
+        ino = msg.data["ino"]
+        for f in list(self._files.get(ino, [])):
+            try:
+                await f.fsync()
+            except (FsError, ConnectionError, OSError):
+                pass
+            f._stale = True
+            f.caps = ""
+        try:
+            await self._send_to_mds(Message("cap_release",
+                                            {"ino": ino}))
+        except (ConnectionError, OSError):
+            pass
+
     async def _find_mds(self, timeout: float = 30.0) -> None:
-        """Resolve the active MDS address from mds_map (FSMap)."""
+        """Resolve the active MDS from the mon's FSMap (MDSMonitor);
+        the legacy mds_map omap object is the fallback so old
+        single-daemon deployments still mount."""
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout
         while loop.time() < deadline:
+            try:
+                fsmap = await self.rados.mon_command("fs dump", {})
+                active = (fsmap or {}).get("active")
+                if active and active.get("addr"):
+                    self.mds_addr = tuple(active["addr"])
+                    return
+            except (RadosError, ConnectionError, OSError,
+                    asyncio.TimeoutError, KeyError, TypeError):
+                pass
             try:
                 omap = await self.meta.get_omap(MDSMAP_OID)
                 raw = omap.get("addr")
@@ -119,6 +240,14 @@ class CephFS:
         raise FsError("ETIMEDOUT", "no active mds")
 
     async def _on_reply(self, conn, msg: Message) -> None:
+        if msg.type == "cap_revoke":
+            await self._on_cap_revoke(msg)
+            return
+        if msg.type == "session_renew_ack":
+            fut = getattr(self, "_renew_waiter", None)
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+            return
         if msg.type != "mds_reply":
             return
         fut = self._waiters.pop(msg.data.get("tid"), None)
@@ -197,9 +326,13 @@ class CephFS:
     async def open(self, path: str, flags: str = "r",
                    mode: int = 0o644) -> FsFile:
         create = "w" in flags or "a" in flags or "+" in flags
+        want = "w" if create else "r"
         out = await self._request({"op": "open", "path": path,
-                                   "create": create, "mode": mode})
-        f = FsFile(self, path, out["dentry"], append="a" in flags)
+                                   "create": create, "mode": mode,
+                                   "want": want})
+        self._note_lease()
+        f = FsFile(self, path, out["dentry"], append="a" in flags,
+                   caps=out.get("caps", want))
         if "w" in flags:        # 'w' and 'w+' both truncate (fopen(3))
             await f.truncate(0)
         return f

@@ -14,11 +14,20 @@ A compressed rendering of src/mds:
     dentry/inode.  File DATA never touches the MDS -- clients stripe
     it straight to the data pool (the layout rides in the inode), the
     defining CephFS data path split.
-  * Single active MDS with hot standby: activation is an exclusive
-    cls_lock on the ``mds_map`` object (+ renewal); the standby polls,
-    wins the lock on holder death, replays the journal, and publishes
-    its address in mds_map -- MDSMonitor/FSMap failover compressed to
-    a lock (no mon involvement).
+  * Mon-owned FSMap (src/mon/MDSMonitor.cc): every MDS beacons the
+    monitor; the LEADER assigns the active rank and promotes a standby
+    when the active's beacons lapse.  An MDS only activates when the
+    FSMap names it -- the journal cls_lock remains as the WRITE FENCE
+    (the blocklist analog: a deposed active whose lease lapsed cannot
+    append), so membership is mon-decided and split-brain is
+    lock-fenced.
+  * Client capabilities with lease expiry (src/mds/Locker.cc
+    compressed to two cap modes): "r" holders may read and cache, the
+    single "w" holder may write data and buffer size updates.  A
+    conflicting open REVOKES: holders flush dirty state and release;
+    a dead client's caps lapse with its lease so revocation cannot
+    hang.  Data-path fencing of a revoked-but-alive client across MDS
+    failover (the OSD blocklist) is out of scope and noted here.
   * unlink purges file data through the striper after the journal
     commits (PurgeQueue analog).
 """
@@ -41,6 +50,9 @@ LOCK_NAME = "mds_active"
 LOCK_DURATION = 6.0
 LOCK_RENEW = 2.0
 TRIM_EVERY = 64
+BEACON_INTERVAL = 1.0
+BEACON_GRACE = 8.0
+CAP_LEASE = 8.0
 
 DEFAULT_LAYOUT = {"su": 1 << 22, "sc": 1, "os": 1 << 22}
 
@@ -78,11 +90,18 @@ class MDS:
         # trim window (the reference replays its session table)
         self._completed: dict[str, dict] = {}
         self._stopped = False
+        # sessions + capabilities (SessionMap/Locker compressed):
+        # caps[ino][client] = {"mode": "r"|"w", "expires": t}
+        self.sessions: dict[str, dict] = {}
+        self.caps: dict[int, dict[str, dict]] = {}
+        self._revoke_acks: dict[tuple[int, str], asyncio.Event] = {}
+        self.mon_addr: tuple[str, int] | None = None
         self.msgr.add_dispatcher(self._dispatch)
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self, mon_addr: tuple[str, int],
                     create_pools: bool = True) -> tuple[str, int]:
+        self.mon_addr = tuple(mon_addr)
         self.rados = await Rados(mon_addr, name=f"mds.{self.name}"
                                  ).connect()
         pools = await self.rados.pool_list()
@@ -115,10 +134,39 @@ class MDS:
         if self.rados:
             await self.rados.shutdown()
 
-    # -- standby -> active (FSMap failover via lock) -------------------------
+    # -- beacons / FSMap-gated activation ------------------------------------
+    async def _send_beacon(self) -> dict | None:
+        """One MMDSBeacon to the mon; returns the ack (or None)."""
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def d(conn, msg):
+            if msg.type == "mds_beacon_ack":
+                await q.put(msg.data)
+        self.msgr.add_dispatcher(d)
+        try:
+            await self.msgr.send(self.mon_addr, "mon.0", Message(
+                "mds_beacon", {"name": self.name,
+                               "addr": list(self.addr),
+                               "state": self.state}))
+            return await asyncio.wait_for(q.get(), 3.0)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            return None
+        finally:
+            self.msgr.dispatchers.remove(d)
+
     async def _standby_loop(self) -> None:
+        """Beacon the mon; activate only when the FSMap names us.
+
+        The mon owns MEMBERSHIP (who should be active); the journal
+        cls_lock stays as the write FENCE -- a daemon the map deposed
+        while its lease was still live simply waits the lease out."""
         try:
             while not self._stopped:
+                ack = await self._send_beacon()
+                if (ack is None or ack.get("you") != "active"):
+                    await asyncio.sleep(BEACON_INTERVAL)
+                    continue
+                # the FSMap names us active: take the journal fence
                 try:
                     await self.meta.exec(
                         MDSMAP_OID, "lock", "lock", json.dumps({
@@ -130,9 +178,27 @@ class MDS:
                     await asyncio.sleep(1.0)
                     continue
                 await self._become_active()
-                last_renew = asyncio.get_event_loop().time()
-                while not self._stopped:      # renewal loop
+                loop = asyncio.get_event_loop()
+                last_renew = loop.time()
+                last_ack = loop.time()
+                while not self._stopped:      # renewal + beacon loop
                     await asyncio.sleep(LOCK_RENEW)
+                    ack = await self._send_beacon()
+                    if ack is not None:
+                        last_ack = loop.time()
+                        if ack.get("you") == "standby":
+                            # the mon deposed us (fsmap changed): stop
+                            # serving NOW; the journal lease fences
+                            # stale appends until it lapses
+                            self.state = "standby"
+                            break
+                    elif loop.time() - last_ack > BEACON_GRACE:
+                        # mon unreachable past the grace: the mon has
+                        # (or will have) promoted a standby -- serving
+                        # on while renewing the lock would block that
+                        # standby forever.  Demote and stop renewing.
+                        self.state = "standby"
+                        break
                     try:
                         await self.meta.exec(
                             MDSMAP_OID, "lock", "lock", json.dumps({
@@ -341,15 +407,94 @@ class MDS:
         except RadosError:
             pass
 
+    # -- capabilities (Locker.cc compressed) ---------------------------------
+    def _prune_caps(self, ino: int) -> dict[str, dict]:
+        now = _now()
+        holders = self.caps.get(ino, {})
+        for client in [c for c, cap in holders.items()
+                       if cap["expires"] < now]:
+            holders.pop(client)
+        if not holders:
+            self.caps.pop(ino, None)
+        return self.caps.get(ino, {})
+
+    async def _revoke_cap(self, ino: int, client: str) -> None:
+        """Ask ``client`` to flush + release its cap on ``ino``; waits
+        for the release ack or the cap's lease expiry, whichever comes
+        first (a dead client cannot wedge the grant)."""
+        cap = self.caps.get(ino, {}).get(client)
+        sess = self.sessions.get(client)
+        if cap is None:
+            return
+        ev = asyncio.Event()
+        self._revoke_acks[(ino, client)] = ev
+        if sess is not None and sess.get("conn") is not None:
+            try:
+                await sess["conn"].send(Message(
+                    "cap_revoke", {"ino": ino, "mode": cap["mode"]}))
+            except (ConnectionError, OSError):
+                pass
+        timeout = max(0.1, cap["expires"] - _now())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass                     # lease lapsed: cap is dead anyway
+        finally:
+            self._revoke_acks.pop((ino, client), None)
+        self.caps.get(ino, {}).pop(client, None)
+
+    async def _acquire_caps(self, ino: int, client: str,
+                            want: str) -> str:
+        """Grant ``want`` ("r" or "w") on ``ino`` to ``client``,
+        revoking conflicting holders first: one writer XOR many
+        readers (the Fr/Fw subset of the cap lattice)."""
+        holders = self._prune_caps(ino)
+        if want == "w":
+            conflicts = [c for c in holders if c != client]
+        else:
+            conflicts = [c for c, cap in holders.items()
+                         if c != client and cap["mode"] == "w"]
+        for other in conflicts:
+            await self._revoke_cap(ino, other)
+        self.caps.setdefault(ino, {})[client] = {
+            "mode": want, "expires": _now() + CAP_LEASE}
+        return want
+
+    def _renew_session(self, client: str) -> None:
+        now = _now()
+        for holders in self.caps.values():
+            cap = holders.get(client)
+            if cap is not None and cap["expires"] >= now:
+                cap["expires"] = now + CAP_LEASE
+        sess = self.sessions.get(client)
+        if sess is not None:
+            sess["renewed"] = now
+
     # -- client RPC ----------------------------------------------------------
     async def _dispatch(self, conn, msg: Message) -> None:
+        client = msg.from_name
+        if msg.type == "cap_release":
+            ino = msg.data["ino"]
+            self.caps.get(ino, {}).pop(client, None)
+            ev = self._revoke_acks.get((ino, client))
+            if ev is not None:
+                ev.set()
+            return
+        if msg.type == "session_renew":
+            self._renew_session(client)
+            try:
+                await conn.send(Message("session_renew_ack", {}))
+            except (ConnectionError, OSError):
+                pass
+            return
         if msg.type != "mds_request":
             return
+        self.sessions[client] = {"conn": conn, "renewed": _now()}
         try:
             if self.state != "active":
                 out = {"err": "EAGAIN", "detail": "mds not active"}
             else:
-                out = await self._handle(msg.data)
+                out = await self._handle(msg.data, client)
         except FsOpError as e:
             out = {"err": e.errno_name, "detail": e.detail}
         except (RadosError, asyncio.TimeoutError) as e:
@@ -360,7 +505,7 @@ class MDS:
         except (ConnectionError, OSError):
             pass
 
-    async def _handle(self, q: dict) -> dict:
+    async def _handle(self, q: dict, client: str = "") -> dict:
         op = q["op"]
         path = q.get("path", "/")
         if op in ("mkdir", "create", "unlink", "rmdir", "rename",
@@ -387,17 +532,31 @@ class MDS:
                     raise FsOpError("ENOTDIR", path)
             return {"entries": await self._dentries(ino)}
         if op == "open":
+            want = q.get("want", "r")
             parent, name, dent = await self._resolve(path,
                                                      want_parent=True)
             if dent is None:
                 if not q.get("create"):
                     raise FsOpError("ENOENT", path)
                 async with self._lock:
-                    return await self._handle_mutation("create", path, q)
-            if dent["type"] == "dir":
-                raise FsOpError("EISDIR", path)
-            return {"dentry": dent, "parent": parent, "name": name,
-                    "caps": "pAsLsXsFsrw"}
+                    out = await self._handle_mutation("create", path, q)
+            else:
+                if dent["type"] == "dir":
+                    raise FsOpError("EISDIR", path)
+                out = {"dentry": dent, "parent": parent, "name": name}
+            # cap grant OUTSIDE the mutation lock: the revoked client's
+            # flush is itself a locked mutation (setattr) and must be
+            # able to land while we wait for its release
+            granted = await self._acquire_caps(
+                out["dentry"]["ino"], client, want)
+            # re-read: the flush may have grown the size we hand out
+            parent2, name2, dent2 = await self._resolve(
+                path, want_parent=True)
+            if dent2 is not None:
+                out["dentry"] = dent2
+            out["caps"] = granted
+            out["lease_s"] = CAP_LEASE
+            return out
         raise FsOpError("EOPNOTSUPP", op)
 
     async def _handle_mutation(self, op: str, path: str,

@@ -693,6 +693,88 @@ class Monitor:
                                  "tid": msg.data.get("tid"),
                                  "epoch": self.osdmap.epoch}))
 
+    # -- MDSMonitor (FSMap) --------------------------------------------------
+    MDS_BEACON_GRACE = 8.0
+
+    async def _h_mds_beacon(self, conn, msg) -> None:
+        """MMDSBeacon: mon-owned MDS membership (MDSMonitor::
+        preprocess_beacon).  The leader assigns the active rank and
+        promotes a standby when the active's beacons go silent past
+        the grace; every change bumps the FSMap epoch through paxos."""
+        name = msg.data["name"]
+        addr = tuple(msg.data["addr"])
+        if not self.is_leader:
+            if self.leader is not None:
+                await self._send_mon(self.leader, Message(
+                    "mds_beacon", dict(msg.data)))
+            # the peon answers from its REPLICATED fsmap: the leader's
+            # assignment reaches the mds even when only a peon is
+            # reachable (the forwarded beacon keeps liveness flowing)
+            fsm = self.services.fsmap
+            you = ("active" if fsm.get("active")
+                   and fsm["active"]["name"] == name else "standby")
+            await conn.send(Message("mds_beacon_ack",
+                                    {"fsmap": fsm, "you": you}))
+            return
+        now = time.monotonic()
+        beats = getattr(self, "mds_last_beacon", None)
+        if beats is None:
+            beats = self.mds_last_beacon = {}
+        beats[name] = now
+        fsmap = self.services.fsmap
+        active = fsmap.get("active")
+        changed = False
+        new = {"epoch": fsmap.get("epoch", 0),
+               "active": dict(active) if active else None,
+               "standbys": [dict(s) for s in fsmap.get("standbys", [])]}
+        if new["active"] and new["active"]["name"] == name:
+            if tuple(new["active"]["addr"]) != addr:
+                new["active"]["addr"] = list(addr)
+                changed = True
+        else:
+            sb = {s["name"]: s for s in new["standbys"]}
+            if name not in sb or tuple(sb[name]["addr"]) != addr:
+                sb[name] = {"name": name, "addr": list(addr)}
+                new["standbys"] = list(sb.values())
+                changed = True
+        # failover: the active's beacons lapsed -> promote a live
+        # standby (MDSMonitor::tick fail_mds_gid path)
+        act = new["active"]
+        if act is not None and act["name"] != name:
+            # a fresh leader has an empty beacon table: grace is
+            # measured from FIRST observation, never from epoch zero
+            last = beats.setdefault(act["name"], now)
+            if now - last > self.MDS_BEACON_GRACE:
+                act = None
+        if act is None:
+            live = [s for s in new["standbys"]
+                    if now - beats.get(s["name"], 0.0)
+                    < self.MDS_BEACON_GRACE]
+            if live:
+                promoted = live[0]
+                new["standbys"] = [s for s in new["standbys"]
+                                   if s["name"] != promoted["name"]]
+                # a deposed daemon rejoins as a standby on its next
+                # beacon (the registration branch above)
+                new["active"] = promoted
+                changed = True
+            else:
+                if new["active"] is not None:
+                    new["active"] = None
+                    changed = True
+        if changed:
+            new["epoch"] = new.get("epoch", 0) + 1
+            await self.propose_service_kv("fsmap", {"map": new})
+        fsmap = self.services.fsmap
+        you = ("active" if fsmap.get("active")
+               and fsmap["active"]["name"] == name else "standby")
+        await conn.send(Message("mds_beacon_ack",
+                                {"fsmap": fsmap, "you": you}))
+
+    async def _h_sub_fsmap(self, conn, msg) -> None:
+        await conn.send(Message("fsmap",
+                                {"fsmap": self.services.fsmap}))
+
     async def _h_mgr_beacon(self, conn, msg) -> None:
         """Track the active mgr and publish its address to subscribers
         (the MgrMap analog; MgrMonitor::prepare_beacon)."""

@@ -41,6 +41,12 @@ class MonServices:
         self.auth_db: dict[str, dict] = {}        # entity -> {key, caps}
         self.cluster_log: list[dict] = []         # ring of log entries
         self.log_seq = 0
+        # FSMap (MDSMonitor): mon-owned MDS membership -- which daemon
+        # is the active metadata server, who stands by, epoch per
+        # change (src/mon/MDSMonitor.cc / FSMap).  Replicated through
+        # paxos like every service; beacon liveness is in-memory on
+        # the leader (mds_last_beacon on the Monitor).
+        self.fsmap: dict = {"epoch": 0, "active": None, "standbys": []}
 
     # -- replication hook ----------------------------------------------------
     def apply(self, service_kv: dict) -> None:
@@ -57,6 +63,10 @@ class MonServices:
             else:
                 self.auth_db[entity] = json.loads(val) \
                     if isinstance(val, str) else val
+        fsval = service_kv.get("fsmap", {}).get("map")
+        if fsval is not None:
+            self.fsmap = (json.loads(fsval)
+                          if isinstance(fsval, str) else fsval)
         for _, val in sorted(service_kv.get("log", {}).items()):
             entry = json.loads(val) if isinstance(val, str) else val
             self.cluster_log.append(entry)
@@ -146,6 +156,8 @@ class MonServices:
     async def handle_command(self, cmd: str, args: dict):
         """Returns the result, or raises UnknownCommand to fall through."""
         mon = self.mon
+        if cmd == "fs dump":
+            return dict(self.fsmap)
         if cmd == "config set":
             who = args.get("who", "global")
             await mon.propose_service_kv("config", {

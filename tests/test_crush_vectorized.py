@@ -165,3 +165,31 @@ def test_choose_args_weight_set_scalar_and_vector():
     for i in range(len(xs)):
         assert list(goti[i]) == steered_i[i], \
             (i, list(goti[i]), steered_i[i])
+
+
+def test_firstn_exhausted_slot_compacts_like_scalar():
+    """When a replica slot exhausts every try (nearly-all-out
+    cluster), scalar firstn compacts -- the fused engine must produce
+    the same compacted prefix, including drawing later slots at the
+    UNADVANCED weight-set position."""
+    from ceph_tpu.crush.builder import build_hierarchy
+    from ceph_tpu.crush.vectorized import VectorCrush
+    from ceph_tpu.crush import crush_do_rule
+
+    rng = np.random.default_rng(17)
+    cm = build_hierarchy([3, 3])             # 9 osds
+    cm.choose_args = {-1: {"weight_set": [
+        [0x10000, 0x20000, 0x30000],
+        [0x30000, 0x10000, 0x20000],
+        [0x20000, 0x30000, 0x10000]]}}
+    # only two osds in: most lanes cannot place 3 replicas
+    weights = [0] * 9
+    weights[2] = weights[7] = 0x10000
+    xs = rng.integers(0, 2**31 - 1, size=128, dtype=np.int64)
+    vc = VectorCrush(cm, 0)
+    got = vc.map_pgs(xs, 3, weights)
+    from ceph_tpu.crush.types import CRUSH_ITEM_NONE as NONE
+    for i, x in enumerate(xs):
+        want = crush_do_rule(cm, 0, int(x), 3, weights)
+        trimmed = [v for v in got[i] if v != NONE]
+        assert trimmed == list(want), (i, trimmed, want)

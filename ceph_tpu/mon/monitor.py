@@ -775,6 +775,30 @@ class Monitor:
         await conn.send(Message("fsmap",
                                 {"fsmap": self.services.fsmap}))
 
+    async def _h_osd_slow_ops(self, conn, msg) -> None:
+        """An OSD complains about ops in flight past the complaint
+        threshold (OSD::get_health_metrics -> mon SLOW_OPS health +
+        cluster log)."""
+        osd = msg.data["osd_id"]
+        if not self.is_leader and self.leader is not None:
+            # health answers come from the leader: forward like
+            # _h_osd_failure so the report lands where it is read
+            await self._send_mon(self.leader, Message(
+                "osd_slow_ops", dict(msg.data)))
+            return
+        reports = getattr(self, "slow_ops_reports", None)
+        if reports is None:
+            reports = self.slow_ops_reports = {}
+        reports[osd] = {"count": int(msg.data.get("count", 0)),
+                        "oldest_age": float(msg.data.get(
+                            "oldest_age", 0.0)),
+                        "stamp": time.monotonic()}
+        if self.is_leader and msg.data.get("log"):
+            await self.propose_service_kv("log", self.services.log_entry(
+                "WRN", f"osd.{osd} has {msg.data['count']} slow ops, "
+                       f"oldest {msg.data.get('oldest_age', 0):.0f}s",
+                who=f"osd.{osd}"))
+
     async def _h_mgr_beacon(self, conn, msg) -> None:
         """Track the active mgr and publish its address to subscribers
         (the MgrMap analog; MgrMonitor::prepare_beacon)."""

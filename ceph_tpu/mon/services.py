@@ -137,6 +137,22 @@ class MonServices:
                            f"up OSD count",
                 "detail": [f"pool {p.name} size {p.size} > "
                            f"{n_up} up osds" for p in narrow]}
+        slow = {o: r for o, r in getattr(mon, "slow_ops_reports",
+                                         {}).items()
+                if r["count"] > 0
+                and time.monotonic() - r["stamp"] < 60.0}
+        if slow:
+            total = sum(r["count"] for r in slow.values())
+            oldest = max(r["oldest_age"] for r in slow.values())
+            names = ",".join(f"osd.{o}" for o in sorted(slow))
+            checks["SLOW_OPS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{total} slow ops, oldest one blocked for "
+                           f"{oldest:.0f} sec, daemons [{names}] "
+                           f"have slow ops.",
+                "detail": [f"osd.{o}: {r['count']} ops, oldest "
+                           f"{r['oldest_age']:.0f}s"
+                           for o, r in sorted(slow.items())]}
         beat = getattr(mon, "mgr_last_beacon", 0.0)
         if getattr(mon, "mgr_addr", None) and beat \
                 and time.monotonic() - beat > 30.0:

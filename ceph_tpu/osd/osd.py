@@ -110,8 +110,12 @@ class OSD:
         self.perf_osd = self.perf.create("osd")
         self._notify_serial = itertools.count(1)
         self._notify_waiters: dict[str, asyncio.Future] = {}
-        self._inflight: dict[int, dict] = {}
-        self._op_serial = itertools.count(1)
+        # TrackedOp/OpTracker (src/common/TrackedOp.h): in-flight op
+        # introspection + historic retention + slow-op complaints
+        from ..common.optracker import OpTracker
+        self.op_tracker = OpTracker(
+            complaint_time=float(self.config.get(
+                "osd_op_complaint_time", 30.0)))
         self.admin_socket: AdminSocket | None = None
         self._admin_socket_path = admin_socket_path
 
@@ -214,10 +218,13 @@ class OSD:
                                   for pgid, pg in self.pgs.items()}}
 
         async def ops_in_flight(req):
-            now = time.monotonic()
-            return [{"id": k, **{x: v[x] for x in ("oid", "pgid", "type")},
-                     "age": round(now - v["start"], 4)}
-                    for k, v in self._inflight.items()]
+            return self.op_tracker.dump_ops_in_flight()
+
+        async def historic_ops(req):
+            return self.op_tracker.dump_historic_ops()
+
+        async def historic_ops_by_duration(req):
+            return self.op_tracker.dump_historic_ops_by_duration()
 
         async def config_show(req):
             return self.conf.show()
@@ -233,6 +240,11 @@ class OSD:
         sock.register("status", "osd status", status)
         sock.register("dump_ops_in_flight", "in-flight client ops",
                       ops_in_flight)
+        sock.register("dump_historic_ops", "recently completed ops",
+                      historic_ops)
+        sock.register("dump_historic_ops_by_duration",
+                      "slowest completed ops",
+                      historic_ops_by_duration)
         sock.register("config show", "all config values", config_show)
         sock.register("scrub", "scrub a pg: {pgid, repair}", scrub_cmd)
         sock.register("config get", "describe one option", config_get)
@@ -599,6 +611,28 @@ class OSD:
         if now - getattr(self, "_last_mgr_report", 0.0) > 2.0:
             self._last_mgr_report = now
             self._track(asyncio.ensure_future(self._report_to_mgr()))
+        # slow-op complaints (OSD::get_health_metrics): ops in flight
+        # past osd_op_complaint_time surface in the mon's health and,
+        # once per op, in the cluster log
+        # re-read the threshold each tick: central config may have
+        # changed osd_op_complaint_time at runtime
+        self.op_tracker.complaint_time = float(
+            self.config.get("osd_op_complaint_time", 30.0))
+        slow = self.op_tracker.slow_ops()
+        if slow or getattr(self, "_had_slow_ops", False):
+            self._had_slow_ops = bool(slow)
+            fresh = [o for o in slow
+                     if o.opid not in self.op_tracker.complained]
+            for o in fresh:
+                self.op_tracker.complained.add(o.opid)
+                self.perf_osd.inc("slow_ops")
+            self._track(asyncio.ensure_future(
+                self._mon_send_failover(Message(
+                    "osd_slow_ops",
+                    {"osd_id": self.whoami, "count": len(slow),
+                     "oldest_age": max((o.age for o in slow),
+                                       default=0.0),
+                     "log": bool(fresh)}))))
         # opportunistic re-kicks: a recovery push/pull that raced a peer
         # reboot backs off (the tick restarts it); a peering task that
         # died leaves the PG stranded (the tick re-runs it)
@@ -730,6 +764,7 @@ class OSD:
                         len(ms) for ms in pg.peer_missing.values())
                     backfills += len(pg.backfill_targets)
             summary["pg_states"] = states
+            summary["slow_ops"] = len(self.op_tracker.slow_ops())
             summary["missing_objects"] = missing
             summary["backfills"] = backfills
         except Exception:
@@ -779,16 +814,16 @@ class OSD:
                 "osd_op_reply", {"tid": msg.data.get("tid"),
                                  "err": "ENXIO no such pg"}))
             return
-        opid = next(self._op_serial)
         op_names = [o.get("op") for o in msg.data.get("ops", [])]
-        self._inflight[opid] = {
-            "oid": msg.data["oid"], "pgid": msg.data["pgid"],
-            "type": "+".join(op_names), "start": time.monotonic()}
+        top = self.op_tracker.create(
+            oid=msg.data["oid"], pgid=msg.data["pgid"],
+            type="+".join(op_names),
+            client=str(msg.from_name))
         try:
             with self.perf_osd.time("op_latency"):
-                data, segments = await pg.do_op(msg, conn)
+                data, segments = await pg.do_op(msg, conn, top=top)
         finally:
-            self._inflight.pop(opid, None)
+            top.finish()
         if "err" not in data:          # rejected ops aren't throughput
             self.perf_osd.inc("op")
             if any(n in WRITE_OPS for n in op_names):

@@ -572,14 +572,19 @@ class PG:
             self.osd.store.queue_transaction(txn)
 
     # -- client op execution (primary) --------------------------------------
-    async def do_op(self, msg, conn=None) -> tuple[dict, list[bytes]]:
+    async def do_op(self, msg, conn=None,
+                    top=None) -> tuple[dict, list[bytes]]:
         ops = unpack_mutations(msg.data["ops"], msg.segments)
         oid = msg.data["oid"]
         rq = msg.data.get("reqid")
         reqid = (rq[0], rq[1]) if rq else None
         snapc = msg.data.get("snapc")
         snapid = msg.data.get("snapid")
+        if top is not None:
+            top.event("queued_for_pg")
         async with self.lock:
+            if top is not None:
+                top.event("reached_pg")
             if self.state != "active" or not self.is_primary():
                 return ({"err": "ENOTPRIMARY", "state": self.state}, [])
             if reqid is not None and reqid in self._completed_reqids:
@@ -682,8 +687,12 @@ class PG:
                 else:
                     results.append({"err": f"EOPNOTSUPP {name}"})
             if writes:
+                if top is not None:
+                    top.event("started")
                 err = await self._do_writes(oid, writes, reqid,
                                             snapc=snapc)
+                if top is not None:
+                    top.event("commit_sent")
                 if err:
                     return ({"err": err}, [])
             ret = ({"results": results,

@@ -184,7 +184,8 @@ def test_osd_admin_socket_live(tmp_path):
             assert found_op
             ops = await admin_command(str(tmp_path / "osd0.asok"),
                                       "dump_ops_in_flight")
-            assert isinstance(ops, list)
+            assert isinstance(ops["ops"], list)
+            assert ops["num_ops"] == len(ops["ops"])
             mst = await admin_command(str(tmp_path / "mon.asok"),
                                       "mon_status")
             assert mst["leader"] is True

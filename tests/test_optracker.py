@@ -1,0 +1,97 @@
+"""OpTracker: in-flight op introspection with event timelines,
+historic retention, and slow-op health surfacing (TrackedOp.h,
+OSD::get_health_metrics)."""
+
+import asyncio
+import json
+
+from test_backfill import wait_for
+from test_osd_cluster import make_cluster, run
+
+
+def test_stalled_op_visible_in_flight_then_historic():
+    """A deliberately-stalled op shows in dump_ops_in_flight (with its
+    event timeline and age) through the admin-socket CLI path, raises
+    the SLOW_OPS health warning, and lands in dump_historic_ops with
+    its true duration once it completes."""
+    async def main(tmp_sock):
+        c = await make_cluster(
+            2, osd_config={"osd_op_complaint_time": 0.5})
+        try:
+            await c.command("osd pool create",
+                            {"name": "p", "pg_num": 1, "size": 2,
+                             "min_size": 1})
+            await c.osd_op("p", "obj", [
+                {"op": "write", "off": 0, "data": b"x"}])
+            pgid, primary, _ = c.target_for("p", "obj")
+            posd = next(o for o in c.osds if o.whoami == primary)
+            # expose an admin socket on the live daemon
+            from ceph_tpu.common.admin_socket import (
+                AdminSocket, admin_command)
+            posd._admin_socket_path = tmp_sock
+            posd.admin_socket = AdminSocket(tmp_sock)
+            posd._register_admin_commands()
+            await posd.admin_socket.start()
+
+            # stall: hold the PG lock while a client op arrives
+            pg = posd.pgs[pgid]
+            await pg.lock.acquire()
+            op_task = asyncio.ensure_future(c.osd_op(
+                "p", "obj", [{"op": "write", "off": 0,
+                              "data": b"stalled-write"}]))
+            # the op parks at queued_for_pg; the CLI shows it
+            async def visible():
+                out = await admin_command(tmp_sock,
+                                          "dump_ops_in_flight")
+                return out["num_ops"] >= 1
+            for _ in range(100):
+                if await visible():
+                    break
+                await asyncio.sleep(0.05)
+            out = await admin_command(tmp_sock, "dump_ops_in_flight")
+            assert out["num_ops"] >= 1, out
+            op = out["ops"][0]
+            assert op["oid"] == "obj"
+            events = [e["event"] for e in op["events"]]
+            assert events[:2] == ["initiated", "queued_for_pg"]
+            assert "reached_pg" not in events          # stalled
+            # past the complaint time: SLOW_OPS health fires
+            await asyncio.sleep(0.8)
+            await wait_for(
+                lambda: c.mon.services.health()["checks"].get(
+                    "SLOW_OPS") is not None,
+                timeout=15, msg="SLOW_OPS health check")
+            age_before = (await admin_command(
+                tmp_sock, "dump_ops_in_flight"))["ops"][0]["age"]
+            assert age_before > 0.5
+
+            pg.lock.release()
+            await op_task
+            # finished: gone from in-flight, present in historic with
+            # the stall reflected in its duration and event trail
+            out = await admin_command(tmp_sock, "dump_ops_in_flight")
+            assert out["num_ops"] == 0
+            hist = await admin_command(tmp_sock, "dump_historic_ops")
+            match = [o for o in hist["ops"]
+                     if o["oid"] == "obj" and o["duration"] > 0.5]
+            assert match, hist
+            events = [e["event"] for e in match[-1]["events"]]
+            assert events[-1] == "done"
+            assert "reached_pg" in events and "started" in events
+            slow = await admin_command(
+                tmp_sock, "dump_historic_ops_by_duration")
+            assert slow["ops"][0]["duration"] >= \
+                slow["ops"][-1]["duration"]
+            # health clears once the op completes
+            await wait_for(
+                lambda: "SLOW_OPS" not in
+                c.mon.services.health()["checks"],
+                timeout=90, msg="SLOW_OPS clears")
+        finally:
+            await c.stop()
+
+    import tempfile, os
+    d = tempfile.mkdtemp()
+    run(main(os.path.join(d, "osd.asok")))
+
+

@@ -42,6 +42,20 @@ DEFAULT_M = "1"
 DEFAULT_W = "8"
 
 
+class GF2WBackend:
+    """Word-region matmul backend for the w=16/32 word techniques
+    (galois_w16/w32_region_mult semantics, ec/gf2w_region.py).  The
+    TPU bit-matmul path is GF(2^8); wide-word codecs run here."""
+
+    def __init__(self, w: int) -> None:
+        self.w = w
+        self.name = f"gf2w{w}"
+
+    def matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        from ..gf2w_region import gf2w_matmul
+        return gf2w_matmul(matrix, data, self.w)
+
+
 class ErasureCodeJerasure(RSMatrixCodec):
     technique = "reed_sol_van"
     DEFAULT_K = DEFAULT_K
@@ -51,6 +65,16 @@ class ErasureCodeJerasure(RSMatrixCodec):
         super().__init__(backend=backend)
         self.w = 8
         self.per_chunk_alignment = False
+
+    def _use_gf2w(self) -> bool:
+        return self.w in (16, 32)
+
+    def _build_decode_matrix(self, erasures):
+        if self._use_gf2w():
+            from ..gf2w_region import build_decode_matrix_w
+            return build_decode_matrix_w(self.encode_matrix, self.k,
+                                         erasures, self.w)
+        return super()._build_decode_matrix(erasures)
 
     def get_alignment(self) -> int:
         if self.per_chunk_alignment:
@@ -81,9 +105,10 @@ class ErasureCodeJerasure(RSMatrixCodec):
         if self.w not in (8, 16, 32):
             # reference resets to default with a notice (:154-160)
             self.w = 8
-        if self.w != 8:
-            raise NotImplementedError(
-                "jerasure w=16/32 (GF(2^16)/GF(2^32) words) not yet built")
+        if self._use_gf2w():
+            # wide words: GF(2^w) region backend (the injected GF(2^8)
+            # bit-matmul backend cannot serve these fields)
+            self.backend = GF2WBackend(self.w)
         self.per_chunk_alignment = (
             str(profile.get("jerasure-per-chunk-alignment", "false")).lower()
             in ("true", "1", "yes"))
@@ -101,6 +126,12 @@ class ErasureCodeJerasureReedSolomonVandermonde(ErasureCodeJerasure):
     DEFAULT_M = "3"
 
     def prepare(self) -> None:
+        if self._use_gf2w():
+            from ..gf2w_region import gen_rs_vandermonde_w, _DTYPE
+            coding = gen_rs_vandermonde_w(self.k, self.m, self.w)
+            ident = np.eye(self.k, dtype=_DTYPE[self.w])
+            self.encode_matrix = np.concatenate([ident, coding], axis=0)
+            return
         coding = gen_jerasure_rs_vandermonde(self.k, self.m)
         self.encode_matrix = np.concatenate(
             [np.eye(self.k, dtype=np.uint8), coding], axis=0)
@@ -117,6 +148,12 @@ class ErasureCodeJerasureReedSolomonRAID6(ErasureCodeJerasure):
         self.m = 2
 
     def prepare(self) -> None:
+        if self._use_gf2w():
+            from ..gf2w_region import gen_raid6_w, _DTYPE
+            coding = gen_raid6_w(self.k, self.w)
+            ident = np.eye(self.k, dtype=_DTYPE[self.w])
+            self.encode_matrix = np.concatenate([ident, coding], axis=0)
+            return
         k = self.k
         coding = np.zeros((2, k), dtype=np.uint8)
         coding[0, :] = 1

@@ -52,8 +52,15 @@ class ErasureCodeShec(ErasureCode):
         self.m = self.to_int("m", profile, "3")
         self.c = self.to_int("c", profile, "2")
         self.w = self.to_int("w", profile, "8")
-        if self.w not in (8, 16, 32):
-            raise ValueError(f"shec: w={self.w} must be 8/16/32")
+        if self.w != 8:
+            # the SHEC coding matrix and all encode/decode math here
+            # are GF(2^8); accepting w=16/32 would produce chunks that
+            # are self-consistent but NOT the reference's w=16/32
+            # encodings, and without the larger field's recoverability
+            # -- refuse loudly instead (round-3 advisor finding)
+            raise ValueError(
+                f"shec: w={self.w} unsupported (GF(2^8) only; "
+                f"use jerasure for w=16/32 word techniques)")
         if not 1 <= self.c <= self.m:
             raise ValueError(f"shec: need 1 <= c={self.c} <= m={self.m}")
         if self.k < 1 or self.m < 1:

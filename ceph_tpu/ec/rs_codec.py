@@ -87,6 +87,12 @@ class RSMatrixCodec(ErasureCode):
         for r in range(m):
             chunks[self.chunk_index(k + r)][:] = parity[r]
 
+    def _build_decode_matrix(self, erasures: list[int]):
+        """Decode-matrix construction hook: wider-field codecs (the
+        jerasure w=16/32 word techniques) override the FIELD while the
+        driver above stays shared."""
+        return build_decode_matrix(self.encode_matrix, self.k, erasures)
+
     def decode_chunks(
         self, want_to_read: set[int], chunks: Mapping[int, np.ndarray],
         decoded: dict[int, np.ndarray],
@@ -102,8 +108,7 @@ class RSMatrixCodec(ErasureCode):
             decode_index_for(k, set(erasures)), erasures)
         entry = self.tcache.get(signature)
         if entry is None:
-            matrix, decode_index = build_decode_matrix(
-                self.encode_matrix, k, erasures)
+            matrix, decode_index = self._build_decode_matrix(erasures)
             self.tcache.put(signature, matrix, decode_index)
         else:
             matrix, decode_index = entry

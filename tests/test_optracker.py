@@ -3,7 +3,6 @@ historic retention, and slow-op health surfacing (TrackedOp.h,
 OSD::get_health_metrics)."""
 
 import asyncio
-import json
 
 from test_backfill import wait_for
 from test_osd_cluster import make_cluster, run

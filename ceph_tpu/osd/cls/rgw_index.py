@@ -16,8 +16,17 @@ import json
 
 from . import CLS_METHOD_RD, CLS_METHOD_WR, ClsError, register
 
-_ENTRY = "idx_"          # idx_<object key> -> entry json
+_ENTRY = "idx_"          # idx_<object key> -> entry json (current)
 _PENDING = "pend_"       # pend_<tag> -> {key, op}
+# versioned buckets (cls_rgw's bucket index versioning ops): every
+# version of a key lives at vidx_<key>\x00<inverted stamp> so the
+# omap's name order lists versions newest-first per key; the idx_
+# entry stays the CURRENT pointer (possibly a delete marker)
+_VENTRY = "vidx_"
+
+
+def _vkey(key: str, version_id: str) -> str:
+    return f"{_VENTRY}{key}\x00{version_id}"
 
 
 @register("rgw_index", "prepare", CLS_METHOD_RD | CLS_METHOD_WR)
@@ -91,13 +100,23 @@ def list_entries(hctx, indata: bytes) -> bytes:
     if not hctx.exists():
         return json.dumps({"entries": [], "truncated": False}).encode()
     all_kv = hctx.map_get_all()
-    keys = sorted(k[len(_ENTRY):] for k in all_kv
-                  if k.startswith(_ENTRY))
-    keys = [k for k in keys if k.startswith(prefix) and k > marker]
-    page = keys[:limit]
-    entries = [[k, json.loads(all_kv[_ENTRY + k])] for k in page]
+    entries = []
+    truncated = False
+    for k in sorted(all_kv):
+        if not k.startswith(_ENTRY):
+            continue
+        name = k[len(_ENTRY):]
+        if not name.startswith(prefix) or name <= marker:
+            continue
+        e = json.loads(all_kv[k])
+        if e.get("delete_marker"):
+            continue
+        if len(entries) >= limit:
+            truncated = True          # one survivor past the page
+            break
+        entries.append([name, e])
     return json.dumps({"entries": entries,
-                       "truncated": len(keys) > limit}).encode()
+                       "truncated": truncated}).encode()
 
 
 @register("rgw_index", "dir_link", CLS_METHOD_RD | CLS_METHOD_WR)
@@ -149,6 +168,144 @@ def stats(hctx, indata: bytes) -> bytes:
     count = tot = 0
     for k, v in hctx.map_get_all().items():
         if k.startswith(_ENTRY):
+            e = json.loads(v)
+            if e.get("delete_marker"):
+                continue
             count += 1
-            tot += json.loads(v).get("size", 0)
+            tot += e.get("size", 0)
     return json.dumps({"count": count, "bytes": tot}).encode()
+
+
+@register("rgw_index", "version_put", CLS_METHOD_RD | CLS_METHOD_WR)
+def version_put(hctx, indata: bytes) -> bytes:
+    """Link a NEW version of a key atomically: store the version
+    entry, flip the current pointer.  versioning=suspended reuses the
+    "null" version id and DISPLACES the previous null version (its
+    entry is returned for data reclaim, as `complete` does); enabled
+    displaces nothing (old versions stay readable)."""
+    q = json.loads(indata)
+    key = q["key"]
+    entry = q["entry"]
+    displaced = b""
+    try:
+        cur_raw = hctx.map_get_val(_ENTRY + key)
+        cur = json.loads(cur_raw)
+    except ClsError:
+        cur_raw, cur = b"", None
+    unversioned_cur = cur is not None and "version_id" not in cur
+    if q.get("suspended"):
+        entry["version_id"] = "null"
+        try:
+            displaced = hctx.map_get_val(_vkey(key, "null"))
+        except ClsError:
+            # only a true UNVERSIONED-era entry is displaced; an
+            # enabled-era version must stay readable (its vidx_ row
+            # still references the data)
+            displaced = cur_raw if unversioned_cur else b""
+    elif unversioned_cur:
+        # enabling versioning over an unversioned object: S3 preserves
+        # it as the "null" version, not as silent loss
+        cur["version_id"] = "null"
+        hctx.map_set_val(_vkey(key, "null"),
+                         json.dumps(cur).encode())
+    blob = json.dumps(entry).encode()
+    hctx.map_set_val(_vkey(key, entry["version_id"]), blob)
+    hctx.map_set_val(_ENTRY + key, blob)
+    return displaced
+
+
+@register("rgw_index", "version_rm", CLS_METHOD_RD | CLS_METHOD_WR)
+def version_rm(hctx, indata: bytes) -> bytes:
+    """Remove ONE version permanently; if it was the current pointer,
+    the next-newest surviving version becomes current (or the key
+    vanishes).  Returns the removed entry for data reclaim."""
+    q = json.loads(indata)
+    key, vid = q["key"], q["version_id"]
+    try:
+        removed = hctx.map_get_val(_vkey(key, vid))
+    except ClsError:
+        raise ClsError("ENOENT", f"{key}?versionId={vid}")
+    hctx.map_remove_key(_vkey(key, vid))
+    try:
+        cur = json.loads(hctx.map_get_val(_ENTRY + key))
+    except ClsError:
+        cur = None
+    if cur is not None and cur.get("version_id") == vid:
+        pre = _VENTRY + key + "\x00"
+        all_kv = hctx.map_get_all()
+        survivors = [json.loads(v) for k, v in all_kv.items()
+                     if k.startswith(pre)]
+        if survivors:
+            # next-newest survivor: mtime first (second granularity),
+            # then the stamp INSIDE the version id (ids are inverted
+            # ns stamps, so plain lexicographic order would resurrect
+            # the OLDEST version); "null" ids sort oldest among ties
+            def recency(e):
+                vid = e.get("version_id", "")
+                try:
+                    ns = (1 << 64) - int(vid[:16], 16)
+                except ValueError:
+                    ns = -1
+                return (e.get("mtime", ""), ns)
+            best = max(survivors, key=recency)
+            hctx.map_set_val(_ENTRY + key, json.dumps(best).encode())
+        else:
+            hctx.map_remove_key(_ENTRY + key)
+    return removed
+
+
+@register("rgw_index", "version_list", CLS_METHOD_RD)
+def version_list(hctx, indata: bytes) -> bytes:
+    """Paged listing of versions: {prefix, marker, max} ->
+    {versions: [[key, version_id, entry, is_latest]...], truncated}."""
+    q = json.loads(indata or b"{}")
+    prefix = q.get("prefix", "")
+    marker = q.get("marker", "")
+    limit = int(q.get("max", 1000))
+    if not hctx.exists():
+        return json.dumps({"versions": [], "truncated": False}).encode()
+    all_kv = hctx.map_get_all()
+    currents = {}
+    for k, v in all_kv.items():
+        if k.startswith(_ENTRY):
+            currents[k[len(_ENTRY):]] = json.loads(v).get("version_id")
+    page = []
+    truncated = False
+    for k in sorted(all_kv):
+        if not k.startswith(_VENTRY):
+            continue
+        name, _, vid = k[len(_VENTRY):].partition("\x00")
+        if not name.startswith(prefix) or k[len(_VENTRY):] <= marker:
+            continue
+        if len(page) >= limit:
+            truncated = True
+            break
+        entry = json.loads(all_kv[k])
+        page.append([name, vid, entry, currents.get(name) == vid])
+    return json.dumps({"versions": page,
+                       "truncated": truncated,
+                       "next_marker": (f"{page[-1][0]}\x00{page[-1][1]}"
+                                       if page else "")}).encode()
+
+
+@register("rgw_index", "get_version", CLS_METHOD_RD)
+def get_version(hctx, indata: bytes) -> bytes:
+    q = json.loads(indata)
+    try:
+        return hctx.map_get_val(_vkey(q["key"], q["version_id"]))
+    except ClsError:
+        raise ClsError("ENOENT", q["key"])
+
+
+@register("rgw_index", "dir_set", CLS_METHOD_RD | CLS_METHOD_WR)
+def dir_set(hctx, indata: bytes) -> bytes:
+    """Merge fields into a directory entry's meta atomically (bucket
+    versioning state, lifecycle config)."""
+    q = json.loads(indata)
+    try:
+        cur = json.loads(hctx.map_get_val("dir_" + q["name"]))
+    except ClsError:
+        raise ClsError("ENOENT", q["name"])
+    cur.update(q["patch"])
+    hctx.map_set_val("dir_" + q["name"], json.dumps(cur).encode())
+    return json.dumps(cur).encode()

@@ -137,8 +137,71 @@ class S3Client:
         _, h, _ = await self.request("HEAD", f"/{bucket}/{key}")
         return h
 
-    async def delete_object(self, bucket: str, key: str) -> None:
-        await self.request("DELETE", f"/{bucket}/{key}")
+    async def delete_object(self, bucket: str, key: str,
+                            version_id: str | None = None) -> dict:
+        q = {"versionId": version_id} if version_id else None
+        _, h, _ = await self.request("DELETE", f"/{bucket}/{key}",
+                                     query=q)
+        return {"delete_marker": h.get("x-amz-delete-marker") == "true",
+                "version_id": h.get("x-amz-version-id")}
+
+    # -- versioning ----------------------------------------------------------
+    async def put_bucket_versioning(self, bucket: str,
+                                    status: str) -> None:
+        body = (f'<VersioningConfiguration>'
+                f"<Status>{status}</Status>"
+                f"</VersioningConfiguration>").encode()
+        await self.request("PUT", f"/{bucket}",
+                           query={"versioning": ""}, body=body)
+
+    async def get_bucket_versioning(self, bucket: str) -> str:
+        _, _, body = await self.request("GET", f"/{bucket}",
+                                        query={"versioning": ""})
+        root = ET.fromstring(body)
+        ns = root.tag.partition("}")[0] + "}" \
+            if root.tag.startswith("{") else ""
+        return root.findtext(f"{ns}Status") or ""
+
+    async def get_object_version(self, bucket: str, key: str,
+                                 version_id: str) -> bytes:
+        _, _, body = await self.request(
+            "GET", f"/{bucket}/{key}", query={"versionId": version_id})
+        return body
+
+    async def list_object_versions(self, bucket: str,
+                                   prefix: str = "") -> list[dict]:
+        _, _, body = await self.request(
+            "GET", f"/{bucket}", query={"versions": "",
+                                        "prefix": prefix})
+        root = ET.fromstring(body)
+        ns = root.tag.partition("}")[0] + "}" \
+            if root.tag.startswith("{") else ""
+        out = []
+        for tag, marker in (("Version", False), ("DeleteMarker", True)):
+            for v in root.findall(f"{ns}{tag}"):
+                out.append({
+                    "key": v.findtext(f"{ns}Key"),
+                    "version_id": v.findtext(f"{ns}VersionId"),
+                    "is_latest": v.findtext(f"{ns}IsLatest") == "true",
+                    "delete_marker": marker,
+                    "size": int(v.findtext(f"{ns}Size") or 0)})
+        out.sort(key=lambda r: (r["key"], r["version_id"] or ""))
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+    async def put_bucket_lifecycle(self, bucket: str,
+                                   rules_xml: bytes) -> None:
+        await self.request("PUT", f"/{bucket}",
+                           query={"lifecycle": ""}, body=rules_xml)
+
+    async def get_bucket_lifecycle(self, bucket: str) -> bytes:
+        _, _, body = await self.request("GET", f"/{bucket}",
+                                        query={"lifecycle": ""})
+        return body
+
+    async def delete_bucket_lifecycle(self, bucket: str) -> None:
+        await self.request("DELETE", f"/{bucket}",
+                           query={"lifecycle": ""})
 
     async def copy_object(self, src_bucket: str, src_key: str,
                           bucket: str, key: str) -> None:

@@ -123,9 +123,10 @@ class Gateway:
     async def _respond(self, writer, req, status, headers, body):
         reason = {200: "OK", 204: "No Content", 206: "Partial Content",
                   400: "Bad Request", 403: "Forbidden",
-                  404: "Not Found", 409: "Conflict",
-                  416: "Range Not Satisfiable",
-                  500: "Internal Server Error"}.get(status, "OK")
+                  404: "Not Found", 405: "Method Not Allowed",
+                  409: "Conflict", 416: "Range Not Satisfiable",
+                  500: "Internal Server Error",
+                  501: "Not Implemented"}.get(status, "Error")
         headers.setdefault("content-length", str(len(body)))
         headers.setdefault("x-amz-request-id", f"{time.time_ns():x}")
         lines = [f"HTTP/1.1 {status} {reason}"]
@@ -224,6 +225,34 @@ class Gateway:
         return 200, {"content-type": "application/xml"}, body
 
     async def _bucket_op(self, req, user, bucket):
+        q = req.query
+        if req.method == "PUT" and "versioning" in q:
+            root = ET.fromstring(req.body)
+            ns = root.tag.partition("}")[0] + "}" \
+                if root.tag.startswith("{") else ""
+            status = root.findtext(f"{ns}Status") or ""
+            await self.store.set_bucket_versioning(bucket, status)
+            return 200, {}, b""
+        if req.method == "GET" and "versioning" in q:
+            state = await self.store.get_bucket_versioning(bucket)
+            inner = f"<Status>{state}</Status>" if state else ""
+            return 200, {"content-type": "application/xml"}, (
+                f'<?xml version="1.0"?>'
+                f'<VersioningConfiguration xmlns="{XMLNS}">{inner}'
+                f"</VersioningConfiguration>").encode()
+        if req.method == "PUT" and "lifecycle" in q:
+            await self.store.set_bucket_lifecycle(
+                bucket, self._parse_lifecycle(req.body))
+            return 200, {}, b""
+        if req.method == "GET" and "lifecycle" in q:
+            rules = await self.store.get_bucket_lifecycle(bucket)
+            return 200, {"content-type": "application/xml"}, \
+                self._lifecycle_xml(rules)
+        if req.method == "DELETE" and "lifecycle" in q:
+            await self.store.delete_bucket_lifecycle(bucket)
+            return 204, {}, b""
+        if req.method == "GET" and "versions" in q:
+            return await self._list_versions(req, bucket)
         if req.method == "PUT":
             await self.store.create_bucket(bucket, user["uid"])
             return 200, {"location": f"/{bucket}"}, b""
@@ -231,13 +260,117 @@ class Gateway:
             await self.store.delete_bucket(bucket)
             return 204, {}, b""
         if req.method in ("GET", "HEAD"):
-            if "uploads" in req.query:
+            if "uploads" in q:
                 return 200, {"content-type": "application/xml"}, (
                     f'<?xml version="1.0"?><ListMultipartUploadsResult '
                     f'xmlns="{XMLNS}"></ListMultipartUploadsResult>'
                 ).encode()
             return await self._list_objects_v2(req, bucket)
         raise RgwError("MethodNotAllowed", 400, req.method)
+
+    @staticmethod
+    def _parse_lifecycle(body: bytes) -> list[dict]:
+        root = ET.fromstring(body)
+        ns = root.tag.partition("}")[0] + "}" \
+            if root.tag.startswith("{") else ""
+        rules = []
+        for r in root.findall(f"{ns}Rule"):
+            rule = {"id": r.findtext(f"{ns}ID") or "",
+                    "prefix": (r.findtext(f"{ns}Prefix")
+                               or r.findtext(f"{ns}Filter/{ns}Prefix")
+                               or ""),
+                    "enabled": (r.findtext(f"{ns}Status") or
+                                "Enabled") == "Enabled"}
+            exp = r.find(f"{ns}Expiration")
+            if exp is not None:
+                days = exp.findtext(f"{ns}Days")
+                if days:
+                    rule["days"] = int(days)
+                if (exp.findtext(f"{ns}ExpiredObjectDeleteMarker")
+                        or "").lower() == "true":
+                    rule["expired_delete_marker"] = True
+            nce = r.find(f"{ns}NoncurrentVersionExpiration")
+            if nce is not None:
+                nd = nce.findtext(f"{ns}NoncurrentDays")
+                if nd:
+                    rule["noncurrent_days"] = int(nd)
+            rules.append(rule)
+        return rules
+
+    @staticmethod
+    def _lifecycle_xml(rules: list[dict]) -> bytes:
+        items = []
+        for r in rules:
+            exp = ""
+            if r.get("days") is not None:
+                exp += f"<Days>{r['days']}</Days>"
+            if r.get("expired_delete_marker"):
+                exp += ("<ExpiredObjectDeleteMarker>true"
+                        "</ExpiredObjectDeleteMarker>")
+            nce = (f"<NoncurrentVersionExpiration><NoncurrentDays>"
+                   f"{r['noncurrent_days']}</NoncurrentDays>"
+                   f"</NoncurrentVersionExpiration>"
+                   if r.get("noncurrent_days") is not None else "")
+            items.append(
+                f"<Rule><ID>{escape(r.get('id', ''))}</ID>"
+                f"<Prefix>{escape(r.get('prefix', ''))}</Prefix>"
+                f"<Status>"
+                f"{'Enabled' if r.get('enabled', True) else 'Disabled'}"
+                f"</Status>"
+                + (f"<Expiration>{exp}</Expiration>" if exp else "")
+                + nce + "</Rule>")
+        return (f'<?xml version="1.0"?>'
+                f'<LifecycleConfiguration xmlns="{XMLNS}">'
+                + "".join(items)
+                + "</LifecycleConfiguration>").encode()
+
+    async def _list_versions(self, req, bucket):
+        prefix = req.query.get("prefix", "")
+        key_marker = req.query.get("key-marker", "")
+        vid_marker = req.query.get("version-id-marker", "")
+        # internal marker is "key\x00vid"; a bare key-marker resumes
+        # AFTER every version of that key (\x01 sorts past them all)
+        if key_marker and vid_marker:
+            marker = f"{key_marker}\x00{vid_marker}"
+        elif key_marker:
+            marker = key_marker + "\x01"
+        else:
+            marker = ""
+        max_keys = int(req.query.get("max-keys", "1000"))
+        out = await self.store.list_object_versions(
+            bucket, prefix=prefix, marker=marker, max_keys=max_keys)
+        items = []
+        for key, vid, e, is_latest in out["versions"]:
+            latest = "true" if is_latest else "false"
+            if e.get("delete_marker"):
+                items.append(
+                    f"<DeleteMarker><Key>{escape(key)}</Key>"
+                    f"<VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{latest}</IsLatest>"
+                    f"<LastModified>{e['mtime']}</LastModified>"
+                    f"</DeleteMarker>")
+            else:
+                items.append(
+                    f"<Version><Key>{escape(key)}</Key>"
+                    f"<VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{latest}</IsLatest>"
+                    f"<LastModified>{e['mtime']}</LastModified>"
+                    f"<ETag>&quot;{e['etag']}&quot;</ETag>"
+                    f"<Size>{e['size']}</Size></Version>")
+        trunc = "true" if out["truncated"] else "false"
+        nxt = ""
+        if out["truncated"] and out.get("next_marker"):
+            nk, _, nv = out["next_marker"].partition("\x00")
+            nxt = (f"<NextKeyMarker>{escape(nk)}</NextKeyMarker>"
+                   f"<NextVersionIdMarker>{escape(nv)}"
+                   f"</NextVersionIdMarker>")
+        return 200, {"content-type": "application/xml"}, (
+            f'<?xml version="1.0"?>'
+            f'<ListVersionsResult xmlns="{XMLNS}">'
+            f"<Name>{escape(bucket)}</Name>"
+            f"<Prefix>{escape(prefix)}</Prefix>"
+            f"<IsTruncated>{trunc}</IsTruncated>{nxt}"
+            + "".join(items) + "</ListVersionsResult>").encode()
 
     async def _list_objects_v2(self, req, bucket):
         prefix = req.query.get("prefix", "")
@@ -339,12 +472,20 @@ class Gateway:
                 bucket, key, req.body, owner=user["uid"],
                 content_type=req.headers.get("content-type", ""),
                 meta=meta)
-            return 200, {"etag": f'"{entry["etag"]}"'}, b""
+            hdrs = {"etag": f'"{entry["etag"]}"'}
+            if entry.get("version_id"):
+                hdrs["x-amz-version-id"] = entry["version_id"]
+            return 200, hdrs, b""
         if req.method in ("GET", "HEAD"):
             off, length = 0, None
             status = 200
+            vid = q.get("versionId")
             rng = req.headers.get("range")
-            entry = await self.store.get_entry(bucket, key)
+            entry = await self.store.get_entry(bucket, key, vid)
+            if entry.get("delete_marker"):
+                raise RgwError("MethodNotAllowed", 405,
+                               "the specified version is a delete "
+                               "marker")
             if rng:
                 m = re.match(r"bytes=(\d*)-(\d*)$", rng)
                 if not m or (not m.group(1) and not m.group(2)):
@@ -365,7 +506,7 @@ class Gateway:
                 data = b""
             else:
                 entry, data = await self.store.get_object(
-                    bucket, key, off, length)
+                    bucket, key, off, length, version_id=vid)
             headers = {
                 "content-type": entry.get("content_type")
                 or "binary/octet-stream",
@@ -378,11 +519,21 @@ class Gateway:
             }
             for mk, mv in entry.get("meta", {}).items():
                 headers[f"x-amz-meta-{mk}"] = mv
+            if entry.get("version_id"):
+                headers["x-amz-version-id"] = entry["version_id"]
             if status == 206:
                 headers["content-range"] = (
                     f"bytes {off}-{off + length - 1}/{entry['size']}")
             return status, headers, data
         if req.method == "DELETE":
-            await self.store.delete_object(bucket, key)
-            return 204, {}, b""
+            if "versionId" in q:
+                await self.store.delete_version(bucket, key,
+                                                q["versionId"])
+                return 204, {"x-amz-version-id": q["versionId"]}, b""
+            marker_vid = await self.store.delete_object(bucket, key)
+            hdrs = {}
+            if marker_vid:
+                hdrs = {"x-amz-delete-marker": "true",
+                        "x-amz-version-id": marker_vid}
+            return 204, hdrs, b""
         raise RgwError("MethodNotAllowed", 400, req.method)

@@ -26,6 +26,12 @@ import time
 from ..client.rados import RadosError
 from ..client.striper import Layout, RadosStriper
 
+# version ids sort NEWEST-FIRST lexicographically (inverted ns stamp +
+# entropy), so the index omap's name order is the S3 version order
+def _new_version_id() -> str:
+    inv = (1 << 64) - time.time_ns()
+    return f"{inv:016x}{os.urandom(4).hex()}"
+
 USERS_OID = "rgw_users"
 BUCKETS_OID = "rgw_buckets"
 
@@ -106,6 +112,12 @@ class RgwStore:
         listing = await self.list_objects(name, max_keys=1)
         if listing["entries"]:
             raise RgwError("BucketNotEmpty", 409, name)
+        # versions and delete markers also block deletion (S3 returns
+        # BucketNotEmpty until every version is purged) -- the plain
+        # listing hides marker-topped keys
+        versions = await self.list_object_versions(name, max_keys=1)
+        if versions["versions"]:
+            raise RgwError("BucketNotEmpty", 409, name)
         try:
             await self.ioctx.exec(BUCKETS_OID, "rgw_index", "dir_unlink",
                                   json.dumps({"name": name}).encode())
@@ -151,10 +163,136 @@ class RgwStore:
         oid = (entry or {}).get("data_oid") or self._data_oid(bucket, key)
         await self.striper.remove(oid)
 
+    # -- versioning ---------------------------------------------------------
+    async def set_bucket_versioning(self, name: str,
+                                    state: str) -> None:
+        """state: "Enabled" | "Suspended" (PutBucketVersioning)."""
+        if state not in ("Enabled", "Suspended"):
+            raise RgwError("IllegalVersioningConfiguration", 400, state)
+        await self.get_bucket(name)
+        await self.ioctx.exec(BUCKETS_OID, "rgw_index", "dir_set",
+                              json.dumps({"name": name, "patch": {
+                                  "versioning": state}}).encode())
+
+    async def get_bucket_versioning(self, name: str) -> str:
+        return (await self.get_bucket(name)).get("versioning", "")
+
+    async def list_object_versions(self, bucket_name: str,
+                                   prefix: str = "", marker: str = "",
+                                   max_keys: int = 1000) -> dict:
+        bucket = await self.get_bucket(bucket_name)
+        raw = json.loads(await self.ioctx.exec(
+            self._index(bucket), "rgw_index", "version_list",
+            json.dumps({"prefix": prefix, "marker": marker,
+                        "max": max_keys}).encode()))
+        return raw
+
+    async def delete_version(self, bucket_name: str, key: str,
+                             version_id: str) -> None:
+        """Permanent removal of one version (DELETE ?versionId=)."""
+        bucket = await self.get_bucket(bucket_name)
+        try:
+            raw = await self.ioctx.exec(
+                self._index(bucket), "rgw_index", "version_rm",
+                json.dumps({"key": key,
+                            "version_id": version_id}).encode())
+        except RadosError as e:
+            if e.errno_name == "ENOENT":
+                return                    # idempotent
+            raise
+        entry = json.loads(raw)
+        if not entry.get("delete_marker"):
+            await self._purge_data(bucket, key, entry)
+
+    # -- lifecycle (rgw_lc.cc compressed) ------------------------------------
+    async def set_bucket_lifecycle(self, name: str,
+                                   rules: list[dict]) -> None:
+        """rules: [{id, prefix, days, noncurrent_days, enabled}]."""
+        await self.get_bucket(name)
+        await self.ioctx.exec(BUCKETS_OID, "rgw_index", "dir_set",
+                              json.dumps({"name": name, "patch": {
+                                  "lifecycle": rules}}).encode())
+
+    async def get_bucket_lifecycle(self, name: str) -> list[dict]:
+        rules = (await self.get_bucket(name)).get("lifecycle")
+        if not rules:
+            raise RgwError("NoSuchLifecycleConfiguration", 404, name)
+        return rules
+
+    async def delete_bucket_lifecycle(self, name: str) -> None:
+        await self.get_bucket(name)
+        await self.ioctx.exec(BUCKETS_OID, "rgw_index", "dir_set",
+                              json.dumps({"name": name, "patch": {
+                                  "lifecycle": None}}).encode())
+
+    @staticmethod
+    def _mtime_age(mtime: str, now: float) -> float:
+        import calendar
+        t = calendar.timegm(time.strptime(mtime,
+                                          "%Y-%m-%dT%H:%M:%S.000Z"))
+        return now - t
+
+    async def lc_process(self, bucket_name: str,
+                         now: float | None = None) -> int:
+        """Run this bucket's lifecycle rules once (RGWLC::process):
+        expire current objects past Days (delete, or delete-marker on
+        versioned buckets), reap noncurrent versions past
+        NoncurrentDays, and drop expired delete markers that are the
+        only thing left of a key.  Returns the action count."""
+        bucket = await self.get_bucket(bucket_name)
+        rules = [r for r in bucket.get("lifecycle") or []
+                 if r.get("enabled", True)]
+        if not rules:
+            return 0
+        now = time.time() if now is None else now
+        versioned = bool(bucket.get("versioning"))
+        actions = 0
+        for rule in rules:
+            prefix = rule.get("prefix", "")
+            days = rule.get("days")
+            if days is not None:
+                listing = await self.list_objects(
+                    bucket_name, prefix=prefix, max_keys=100000)
+                for key, entry in listing["entries"]:
+                    if self._mtime_age(entry["mtime"],
+                                       now) >= days * 86400:
+                        await self.delete_object(bucket_name, key)
+                        actions += 1
+            nc_days = rule.get("noncurrent_days")
+            if versioned and nc_days is not None:
+                vl = await self.list_object_versions(
+                    bucket_name, prefix=prefix, max_keys=100000)
+                for key, vid, entry, is_latest in vl["versions"]:
+                    if is_latest:
+                        continue
+                    if self._mtime_age(entry["mtime"],
+                                       now) >= nc_days * 86400:
+                        await self.delete_version(bucket_name, key,
+                                                  vid)
+                        actions += 1
+            if versioned and rule.get("expired_delete_marker"):
+                vl = await self.list_object_versions(
+                    bucket_name, prefix=prefix, max_keys=100000)
+                per_key: dict[str, list] = {}
+                for row in vl["versions"]:
+                    per_key.setdefault(row[0], []).append(row)
+                for key, rows in per_key.items():
+                    if len(rows) == 1 and rows[0][2].get(
+                            "delete_marker"):
+                        await self.delete_version(bucket_name, key,
+                                                  rows[0][1])
+                        actions += 1
+        return actions
+
     async def put_object(self, bucket_name: str, key: str, data: bytes,
                          owner: str = "", content_type: str = "",
                          meta: dict | None = None) -> dict:
         bucket = await self.get_bucket(bucket_name)
+        versioning = bucket.get("versioning", "")
+        if versioning:
+            return await self._put_object_versioned(
+                bucket, key, data, owner, content_type, meta,
+                suspended=versioning == "Suspended")
         tag = os.urandom(8).hex()
         idx = self._index(bucket)
         await self.ioctx.exec(idx, "rgw_index", "prepare", json.dumps(
@@ -186,6 +324,57 @@ class RgwStore:
             raise
         await self._purge_replaced(bucket, key, raw, soid)
         return entry
+
+    async def _put_object_versioned(self, bucket: dict, key: str,
+                                    data: bytes, owner: str,
+                                    content_type: str,
+                                    meta: dict | None,
+                                    suspended: bool) -> dict:
+        """Versioned PUT: every write is a NEW generation under its
+        own version id (rgw_rados versioned write path); Enabled keeps
+        old versions readable, Suspended reuses the "null" id and
+        reclaims only its previous occupant."""
+        vid = "null" if suspended else _new_version_id()
+        # the DATA oid is always a fresh generation, even for the
+        # reused "null" id: overwriting the live null version's bytes
+        # in place would corrupt it on a crash mid-PUT, and the error
+        # path below must only ever remove bytes nothing references
+        tag = vid if not suspended else f"null.{os.urandom(6).hex()}"
+        soid = self._data_oid(bucket, key, tag)
+        try:
+            if data:
+                await self.striper.write(soid, data, 0)
+            entry = {"size": len(data),
+                     "etag": hashlib.md5(data).hexdigest(),
+                     "mtime": _now_iso(), "owner": owner,
+                     "content_type": content_type, "data_oid": soid,
+                     "version_id": vid, "meta": meta or {}}
+            raw = await self.ioctx.exec(
+                self._index(bucket), "rgw_index", "version_put",
+                json.dumps({"key": key, "entry": entry,
+                            "suspended": suspended}).encode())
+        except Exception:
+            try:
+                await self.striper.remove(soid)
+            except Exception:
+                pass
+            raise
+        await self._purge_replaced(bucket, key, raw, soid)
+        return entry
+
+    async def put_delete_marker(self, bucket: dict, key: str,
+                                suspended: bool) -> str:
+        """S3 DELETE in a versioned bucket: a delete MARKER becomes
+        the current version; data stays."""
+        vid = "null" if suspended else _new_version_id()
+        entry = {"size": 0, "etag": "", "mtime": _now_iso(),
+                 "delete_marker": True, "version_id": vid, "meta": {}}
+        raw = await self.ioctx.exec(
+            self._index(bucket), "rgw_index", "version_put",
+            json.dumps({"key": key, "entry": entry,
+                        "suspended": suspended}).encode())
+        await self._purge_replaced(bucket, key, raw, "")
+        return vid
 
     async def _purge_replaced(self, bucket: dict, key: str,
                               raw: bytes, new_oid: str) -> None:
@@ -220,21 +409,33 @@ class RgwStore:
         await self._purge_replaced(bucket, key, raw, "")
         return entry
 
-    async def get_entry(self, bucket_name: str, key: str) -> dict:
+    async def get_entry(self, bucket_name: str, key: str,
+                        version_id: str | None = None) -> dict:
         bucket = await self.get_bucket(bucket_name)
         try:
-            raw = await self.ioctx.exec(
-                self._index(bucket), "rgw_index", "get",
-                json.dumps({"key": key}).encode())
+            if version_id:
+                raw = await self.ioctx.exec(
+                    self._index(bucket), "rgw_index", "get_version",
+                    json.dumps({"key": key,
+                                "version_id": version_id}).encode())
+            else:
+                raw = await self.ioctx.exec(
+                    self._index(bucket), "rgw_index", "get",
+                    json.dumps({"key": key}).encode())
         except RadosError as e:
             raise RgwError("NoSuchKey", 404, key) from e
-        return json.loads(raw)
+        entry = json.loads(raw)
+        if entry.get("delete_marker") and not version_id:
+            raise RgwError("NoSuchKey", 404, key)
+        return entry
 
     async def get_object(self, bucket_name: str, key: str,
                          off: int = 0,
-                         length: int | None = None) -> tuple[dict, bytes]:
+                         length: int | None = None,
+                         version_id: str | None = None
+                         ) -> tuple[dict, bytes]:
         bucket = await self.get_bucket(bucket_name)
-        entry = await self.get_entry(bucket_name, key)
+        entry = await self.get_entry(bucket_name, key, version_id)
         if length is None:
             length = entry["size"] - off
         length = max(0, min(length, entry["size"] - off))
@@ -261,8 +462,13 @@ class RgwStore:
                 break
         return b"".join(out)
 
-    async def delete_object(self, bucket_name: str, key: str) -> None:
+    async def delete_object(self, bucket_name: str,
+                            key: str) -> str | None:
         bucket = await self.get_bucket(bucket_name)
+        versioning = bucket.get("versioning", "")
+        if versioning:
+            return await self.put_delete_marker(
+                bucket, key, suspended=versioning == "Suspended")
         try:
             raw = await self.ioctx.exec(
                 self._index(bucket), "rgw_index", "unlink",

@@ -29,7 +29,8 @@ CLS_METHOD_WR = 2
 _REGISTRY: dict[str, dict[str, tuple[int, object]]] = {}
 
 # in-tree modules, loaded on first call (dlopen-on-demand analog)
-_KNOWN = ("lock", "refcount", "version", "rbd", "rgw_index")
+_KNOWN = ("lock", "refcount", "version", "rbd", "rgw_index",
+          "journal")
 
 
 class ClsError(Exception):

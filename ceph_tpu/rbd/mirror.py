@@ -32,8 +32,13 @@ SNAP_PREFIX = ".mirror."
 SNAP_RETENTION = 2      # mirror snaps kept per side after a sync
 
 
-async def mirror_enable(ioctx, image_name: str) -> None:
-    await ioctx.set_omap(MIRROR_OID, {image_name: b"enabled"})
+async def mirror_enable(ioctx, image_name: str,
+                        mode: str = "snapshot") -> None:
+    """mode: "snapshot" | "journal" (the reference's per-image mirror
+    image mode); each daemon serves only its own mode."""
+    if mode not in ("snapshot", "journal"):
+        raise RbdError("EINVAL", f"mirror mode {mode!r}")
+    await ioctx.set_omap(MIRROR_OID, {image_name: mode.encode()})
 
 
 async def mirror_disable(ioctx, image_name: str) -> None:
@@ -44,13 +49,26 @@ async def mirror_disable(ioctx, image_name: str) -> None:
             raise       # an unreachable cluster is not "already off"
 
 
-async def mirror_enabled(ioctx) -> list[str]:
+async def mirror_images(ioctx) -> dict[str, str]:
+    """{image_name: mode}; legacy b"enabled" entries read as
+    snapshot mode."""
     try:
-        return sorted((await ioctx.get_omap(MIRROR_OID)).keys())
+        omap = await ioctx.get_omap(MIRROR_OID)
     except RadosError as e:
         if e.errno_name == "ENOENT":
-            return []   # registry object not created yet
+            return {}   # registry object not created yet
         raise           # unreachable cluster must not look like "none"
+    out = {}
+    for name, raw in omap.items():
+        mode = raw.decode()
+        out[name] = "snapshot" if mode == "enabled" else mode
+    return out
+
+
+async def mirror_enabled(ioctx, mode: str | None = None) -> list[str]:
+    imgs = await mirror_images(ioctx)
+    return sorted(n for n, m in imgs.items()
+                  if mode is None or m == mode)
 
 
 def _mirror_snaps(img: Image) -> list[tuple[int, str]]:
@@ -226,6 +244,146 @@ class MirrorDaemon:
 
     async def stop(self) -> None:
         if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+
+# -- journal-based mirroring (src/tools/rbd_mirror journal mode) ------------
+# Snapshot mode (above) ships periodic deltas; journal mode tails the
+# primary image's event journal and REPLAYS it on the secondary, so
+# replication lag is bounded by the tail loop, not the snapshot
+# schedule.
+
+async def journal_bootstrap(src_ioctx, dst_ioctx, image_name: str,
+                            client_id: str = "mirror") -> dict:
+    """Create the secondary image and register as a journal client at
+    the CURRENT head: everything before the registration position is
+    carried over by a full copy, everything after arrives via replay
+    (rbd_mirror ImageReplayer bootstrap)."""
+    from .features import ImageJournal
+    src = await Image.open(src_ioctx, image_name, read_only=True)
+    try:
+        jr = ImageJournal(src_ioctx, src.id)
+        head = await jr.head_seq()
+        rbd = RBD()
+        try:
+            await rbd.create(dst_ioctx, image_name,
+                             src.meta["size"],
+                             order=src.meta["order"],
+                             features=src.meta.get("features"))
+        except RbdError as e:
+            if e.errno_name != "EEXIST":
+                raise
+        dst = await Image.open(dst_ioctx, image_name,
+                               exclusive=False)
+        try:
+            if dst.meta["size"] != src.meta["size"]:
+                await dst.resize(src.meta["size"])
+            step = 1 << src.meta["order"]
+            for off in range(0, src.meta["size"], step):
+                n = min(step, src.meta["size"] - off)
+                buf = await src.read(off, n)
+                if buf.strip(b"\x00"):
+                    await dst.write(off, buf)
+                else:
+                    # a RE-bootstrap over an existing replica must
+                    # clear ranges the primary has since zeroed --
+                    # skipping them would leave stale secondary bytes
+                    await dst.discard(off, n)
+        finally:
+            await dst.close()
+        await jr.register_client(client_id, position=head)
+        return {"position": head}
+    finally:
+        await src.close()
+
+
+async def journal_replay_once(src_ioctx, dst_ioctx, image_name: str,
+                              client_id: str = "mirror",
+                              limit: int = 64) -> int:
+    """Replay journal events past our committed position onto the
+    secondary; commit + trim.  Returns events applied."""
+    from .features import ImageJournal
+    src = await Image.open(src_ioctx, image_name, read_only=True)
+    try:
+        jr = ImageJournal(src_ioctx, src.id)
+        clients = {c["id"]: c for c in await jr.clients()}
+        if client_id not in clients:
+            raise RbdError("ENOENT",
+                           f"journal client {client_id} not "
+                           f"bootstrapped")
+        pos = clients[client_id]["position"]
+        entries = await jr.entries_after(pos, limit=limit)
+        if not entries:
+            return 0
+        dst = await Image.open(dst_ioctx, image_name, exclusive=False)
+        try:
+            for seq, ev, payload in entries:
+                op = ev.get("op")
+                if op == "write":
+                    if ev["off"] + len(payload) > dst.meta["size"]:
+                        await dst.resize(ev["off"] + len(payload))
+                    await dst.write(ev["off"], payload)
+                elif op == "discard":
+                    await dst.discard(ev["off"], ev["len"])
+                elif op == "resize":
+                    await dst.resize(ev["size"])
+                elif op == "snap_create":
+                    try:
+                        await dst.create_snap(ev["name"])
+                    except RbdError as e:
+                        if e.errno_name != "EEXIST":
+                            raise
+                pos = seq
+        finally:
+            await dst.close()
+        await jr.commit(client_id, pos)
+        await jr.trim()
+        return len(entries)
+    finally:
+        await src.close()
+
+
+class JournalMirrorDaemon:
+    """Tail-and-replay loop for journal-mode images."""
+
+    def __init__(self, src_ioctx, dst_ioctx,
+                 interval: float = 0.5) -> None:
+        self.src = src_ioctx
+        self.dst = dst_ioctx
+        self.interval = interval
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+
+    async def replay_all(self) -> dict:
+        out = {}
+        for name in await mirror_enabled(self.src, mode="journal"):
+            try:
+                out[name] = await journal_replay_once(
+                    self.src, self.dst, name)
+            except (RbdError, RadosError, ConnectionError,
+                    OSError) as e:
+                out[name] = f"error: {e}"
+        return out
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._loop())
+
+    async def _loop(self) -> None:
+        try:
+            while not self._stopped:
+                await self.replay_all()
+                await asyncio.sleep(self.interval)
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task:
             self._task.cancel()
             try:
                 await self._task

@@ -60,7 +60,8 @@ class RBD:
 
     async def create(self, ioctx, name: str, size: int, order: int = 22,
                      stripe_unit: int | None = None,
-                     stripe_count: int = 1) -> str:
+                     stripe_count: int = 1,
+                     features: list[str] | None = None) -> str:
         iid = os.urandom(8).hex()
         try:
             await ioctx.exec(RBD_DIRECTORY, "rbd", "dir_add_image",
@@ -72,6 +73,7 @@ class RBD:
             await ioctx.exec(_header(iid), "rbd", "create", json.dumps({
                 "size": int(size), "order": order,
                 "object_prefix": f"rbd_data.{iid}",
+                "features": features or ["layering"],
                 "stripe_unit": stripe_unit or (1 << order),
                 "stripe_count": stripe_count}).encode())
         except RadosError as e:
@@ -109,6 +111,14 @@ class RBD:
                 [img._remove_data_obj(i) for i in range(n_objs)])
         finally:
             await img.close()
+        # feature sidecars die with the image (journal payloads and
+        # object maps have no other owner)
+        from .features import journal_oid, object_map_oid
+        for oid in (journal_oid(img.id), object_map_oid(img.id)):
+            try:
+                await ioctx.remove(oid)
+            except RadosError:
+                pass
         try:
             await ioctx.remove(_header(img.id))
             await ioctx.exec(RBD_DIRECTORY, "rbd", "dir_remove_image",
@@ -178,6 +188,14 @@ class Image:
         self._parent: Image | None = None
         self._closed = False
         self._fenced = False
+        # feature handles (object-map / journaling), bound at open
+        from .features import (FEATURE_JOURNALING, FEATURE_OBJECT_MAP,
+                               ImageJournal, ObjectMap)
+        feats = set(meta.get("features", []))
+        self.object_map = (ObjectMap(self)
+                           if FEATURE_OBJECT_MAP in feats else None)
+        self.journal = (ImageJournal(ioctx, iid)
+                        if FEATURE_JOURNALING in feats else None)
 
     # -- open/close ---------------------------------------------------------
     @staticmethod
@@ -209,6 +227,13 @@ class Image:
             img.snap_id = img._snap_by_name(snapshot)["id"]
         if not img.read_only and exclusive:
             await img._acquire_lock()
+            if img.journal is not None:
+                # the journal is AUTHORITATIVE: events appended by a
+                # writer that died before applying them locally replay
+                # on the next open (librbd journal::Replay), so the
+                # primary can never lag its own journal (and never
+                # diverge from a mirror that already replayed them)
+                await img._journal_local_replay()
             # header watch (librbd's ImageWatcher): another client's
             # snap/resize refreshes OUR snap context before their op
             # completes -- writing with a stale snapc would skip the
@@ -276,6 +301,49 @@ class Image:
                            else e.errno_name,
                            "image is locked by another client") from e
         self._renew_task = asyncio.ensure_future(self._renew_loop())
+
+    JOURNAL_MASTER = "master"
+
+    async def _journal_local_replay(self) -> None:
+        await self.journal.register_client(self.JOURNAL_MASTER)
+        clients = {c["id"]: c for c in await self.journal.clients()}
+        pos = clients[self.JOURNAL_MASTER]["position"]
+        entries = await self.journal.entries_after(pos, limit=10000)
+        for seq, ev, payload in entries:
+            await self._apply_journal_event(ev, payload)
+            pos = seq
+        if entries:
+            await self.journal.commit(self.JOURNAL_MASTER, pos)
+            await self.journal.trim()
+
+    async def _apply_journal_event(self, ev: dict,
+                                   payload: bytes) -> None:
+        """Re-apply one journaled event WITHOUT re-journaling it."""
+        jr, self.journal = self.journal, None
+        try:
+            op = ev.get("op")
+            if op == "write":
+                await self.write(ev["off"], payload)
+            elif op == "discard":
+                await self.discard(ev["off"], ev["len"])
+            elif op == "resize":
+                await self.resize(ev["size"])
+            elif op == "snap_create":
+                try:
+                    await self.create_snap(ev["name"])
+                except RbdError as e:
+                    if e.errno_name != "EEXIST":
+                        raise
+        finally:
+            self.journal = jr
+
+    async def _journal_commit(self, seq: int) -> None:
+        """The local apply landed: the master client is caught up."""
+        try:
+            await self.journal.commit(self.JOURNAL_MASTER, seq)
+            await self.journal.trim()
+        except RadosError:
+            pass          # next open's replay re-applies idempotently
 
     def _writable_or_raise(self) -> None:
         if self.read_only:
@@ -467,8 +535,18 @@ class Image:
             raise RbdError("EINVAL", "write past end of image")
         lay = self._layout
         has_parent = bool(self.meta.get("parent"))
+        jseq = None
+        if self.journal is not None:
+            # journal-safe ordering: the event is durable BEFORE the
+            # image mutates; the master position commits after the
+            # local apply, so a crash in between replays it on reopen
+            jseq = await self.journal.append(
+                {"op": "write", "off": off, "len": len(data)},
+                bytes(data))
 
         async def write_one(objectno, obj_off, piece):
+            if self.object_map is not None:
+                await self.object_map.mark_written(objectno)
             if has_parent and lay.stripe_count == 1:
                 try:
                     await self.ioctx.stat(self._data_obj(objectno))
@@ -490,6 +568,8 @@ class Image:
             await asyncio.gather(*jobs)
         except RadosError as e:
             raise _wrap(e) from e
+        if jseq is not None:
+            await self._journal_commit(jseq)
         return len(data)
 
     async def discard(self, off: int, length: int) -> None:
@@ -498,6 +578,10 @@ class Image:
         self._writable_or_raise()
         lay = self._layout
         has_parent = bool(self.meta.get("parent"))
+        jseq = None
+        if self.journal is not None:
+            jseq = await self.journal.append(
+                {"op": "discard", "off": off, "len": length})
 
         async def one(objectno, obj_off, n):
             oid = self._data_obj(objectno)
@@ -505,6 +589,8 @@ class Image:
                 if obj_off == 0 and n == lay.object_size \
                         and not has_parent:
                     await self.ioctx.remove(oid)
+                    if self.object_map is not None:
+                        await self.object_map.mark_removed(objectno)
                     return
                 if has_parent and lay.stripe_count == 1:
                     # an absent clone object must copyup first: a bare
@@ -525,6 +611,8 @@ class Image:
                 [one(*e) for e in map_extents(lay, off, length)])
         except RadosError as e:
             raise _wrap(e) from e
+        if jseq is not None:
+            await self._journal_commit(jseq)
 
     async def _remove_data_obj(self, objectno: int) -> None:
         try:
@@ -536,6 +624,10 @@ class Image:
     # -- resize -------------------------------------------------------------
     async def resize(self, new_size: int) -> None:
         self._writable_or_raise()
+        jseq = None
+        if self.journal is not None:
+            jseq = await self.journal.append(
+                {"op": "resize", "size": int(new_size)})
         old = self.meta["size"]
         if new_size < old:
             lay = self._layout
@@ -552,14 +644,22 @@ class Image:
                         raise _wrap(e) from e
             await _gather_bounded(
                 [self._remove_data_obj(i) for i in range(keep, total)])
+            if self.object_map is not None:
+                await self.object_map.truncate(keep)
         await self.ioctx.exec(_header(self.id), "rbd", "set_size",
                               json.dumps({"size": new_size}).encode())
+        if jseq is not None:
+            await self._journal_commit(jseq)
         await self._refresh_meta()
         await self._notify_header()
 
     # -- snapshots -----------------------------------------------------------
     async def create_snap(self, snap_name: str) -> int:
         self._writable_or_raise()
+        jseq = None
+        if self.journal is not None:
+            jseq = await self.journal.append(
+                {"op": "snap_create", "name": snap_name})
         sid = await self.ioctx.selfmanaged_snap_create()
         try:
             await self.ioctx.exec(
@@ -569,6 +669,11 @@ class Image:
         except RadosError as e:
             await self.ioctx.selfmanaged_snap_remove(sid)
             raise _wrap(e) from e
+        if self.object_map is not None:
+            # freeze the map under this snap id; head entries go CLEAN
+            await self.object_map.snapshot(sid)
+        if jseq is not None:
+            await self._journal_commit(jseq)
         await self._refresh_meta()
         await self._refresh_snapc()
         await self._notify_header()
@@ -583,6 +688,13 @@ class Image:
                 "snap_id": snap["id"]}).encode()))
         if kids:
             raise RbdError("EBUSY", f"snap has {len(kids)} children")
+        if self.object_map is not None:
+            from .features import object_map_oid
+            try:
+                await self.ioctx.remove(
+                    object_map_oid(self.id, snap["id"]))
+            except RadosError:
+                pass
         try:
             await self.ioctx.exec(
                 _header(self.id), "rbd", "snapshot_remove",

@@ -94,9 +94,11 @@ class Objecter:
         async def one(key, w, target):
             pool_id, oid, cookie = key
             try:
-                await self.op_submit(pool_id, oid,
-                                     [{"op": "watch", "cookie": cookie}],
-                                     nspace=w["nspace"], timeout=10)
+                await self.op_submit(
+                    pool_id, oid,
+                    [{"op": "watch", "cookie": cookie,
+                      "addr": list(self.msgr.addr)}],
+                    nspace=w["nspace"], timeout=10)
                 # only a SUCCESSFUL re-registration settles the target;
                 # a failure leaves it stale so the next map change (or
                 # repeated attempt) retries

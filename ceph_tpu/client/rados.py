@@ -181,7 +181,8 @@ class IoCtx:
         # cookies must be unique across every ioctx of this client: the
         # PG keys watchers by (client entity, cookie)
         cookie = next(self.objecter._tid)
-        await self._op(oid, [{"op": "watch", "cookie": cookie}])
+        await self._op(oid, [{"op": "watch", "cookie": cookie,
+                              "addr": list(self.objecter.msgr.addr)}])
         self.objecter.register_watch(self.pool_id, oid, cookie, callback,
                                      nspace=self.nspace)
         return cookie

@@ -48,13 +48,12 @@ def get_entries(hctx, indata: bytes) -> bytes:
     if not hctx.exists():
         return json.dumps({"entries": []}).encode()
     out = []
-    for k, v in sorted(hctx.map_get_all().items()):
+    start = _ekey(after) if after >= 0 else _ENTRY
+    for k in hctx.map_get_keys(start_after=start, max_return=10000):
         if not k.startswith(_ENTRY):
             continue
-        seq = int(k[len(_ENTRY):])
-        if seq <= after:
-            continue
-        out.append([seq, v.hex()])
+        out.append([int(k[len(_ENTRY):]),
+                    hctx.map_get_val(k).hex()])
         if len(out) >= limit:
             break
     return json.dumps({"entries": out}).encode()
@@ -112,14 +111,16 @@ def trim(hctx, indata: bytes) -> bytes:
     client registers or the feature is disabled)."""
     if not hctx.exists():
         return b"0"
-    kv = hctx.map_get_all()
-    clients = [json.loads(v) for k, v in kv.items()
+    clients = [json.loads(hctx.map_get_val(k))
+               for k in hctx.map_get_keys(start_after=_CLIENT[:-1],
+                                          max_return=10000)
                if k.startswith(_CLIENT)]
     if not clients:
         return b"0"
     floor = min(c["position"] for c in clients)
     n = 0
-    for k in list(kv):
+    for k in hctx.map_get_keys(start_after=_ENTRY[:-1],
+                               max_return=100000):
         if k.startswith(_ENTRY) and int(k[len(_ENTRY):]) <= floor:
             hctx.map_remove_key(k)
             n += 1

@@ -69,10 +69,14 @@ class PG:
         self._recovery_task: asyncio.Task | None = None
         self._peering_task: asyncio.Task | None = None
         self._completed_reqids: dict[tuple[str, int], EVersion] = {}
-        # watch/notify (Watch.cc): oid -> {(client, cookie): conn};
-        # in-memory on the primary -- clients re-watch on map change
-        # (the Objecter's linger resend)
-        self.watchers: dict[str, dict[tuple, object]] = {}
+        # watch/notify (Watch.cc): oid -> {(client, cookie):
+        # {"conn", "addr"}}.  Registrations PERSIST in a replicated
+        # registry object (the reference keeps them in object_info),
+        # so a new primary reloads them at activation and a notify
+        # right after failover still reaches every watcher -- the
+        # objecter's linger re-watch is the backstop, not the only
+        # mechanism
+        self.watchers: dict[str, dict[tuple, dict]] = {}
         self.trimmed_snaps: set[int] = set()
         self._snap_trim_task: asyncio.Task | None = None
         if not self.osd.store.collection_exists(self.coll):
@@ -418,6 +422,7 @@ class PG:
             raise asyncio.TimeoutError(
                 f"pg {self.pgid}: no activate ack from up peers {unacked}")
         self._set_state("active")
+        self._load_watchers()
         self.persist_meta()
         if (self.missing or any(self.peer_missing.values())
                 or self.backfill_targets):
@@ -843,6 +848,45 @@ class PG:
         return {"err": f"EOPNOTSUPP {name}"}, None
 
     # -- watch/notify (Watch.cc) ---------------------------------------------
+    WATCH_REGISTRY_OID = ".rados_watch_registry"
+
+    async def _persist_watchers(self, oid: str) -> None:
+        """Replicate this object's watcher set through the normal
+        write path (PG log + repop), so the registry survives primary
+        failover and travels with recovery/backfill like any object
+        (the reference carries watchers in object_info_t)."""
+        entries = [[cl, ck, w.get("addr")]
+                   for (cl, ck), w in self.watchers.get(oid, {}).items()
+                   if w.get("addr")]
+        try:
+            if entries:
+                await self._do_writes(self.WATCH_REGISTRY_OID, [
+                    {"op": "omap_set",
+                     "kv": {oid: json.dumps(entries).encode()}}], None)
+            else:
+                await self._do_writes(self.WATCH_REGISTRY_OID, [
+                    {"op": "omap_rm", "keys": [oid]}], None)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass              # next watch/unwatch rewrites the set
+
+    def _load_watchers(self) -> None:
+        """Activation: reload persisted registrations (conn-less; the
+        notify path dials their stored addresses)."""
+        try:
+            omap = self.osd.store.omap_get(self.coll,
+                                           self.WATCH_REGISTRY_OID)
+        except Exception:
+            return
+        for oid, raw in omap.items():
+            try:
+                rows = json.loads(raw)
+            except ValueError:
+                continue
+            slot = self.watchers.setdefault(oid, {})
+            for cl, ck, addr in rows:
+                slot.setdefault((cl, int(ck)),
+                                {"conn": None, "addr": addr})
+
     async def _do_watch_op(self, oid: str, op: dict, msg,
                            conn) -> dict:
         name = op["op"]
@@ -851,14 +895,18 @@ class PG:
         if name == "watch":
             if conn is None:
                 return {"err": "EINVAL watch needs a connection"}
-            self.watchers.setdefault(oid, {})[(client, cookie)] = conn
+            self.watchers.setdefault(oid, {})[(client, cookie)] = {
+                "conn": conn, "addr": op.get("addr")}
+            await self._persist_watchers(oid)
             return {"ok": True, "watchers": len(self.watchers[oid])}
         if name == "unwatch":
             self.watchers.get(oid, {}).pop((client, cookie), None)
+            await self._persist_watchers(oid)
             return {"ok": True}
         if name == "list_watchers":
-            live = {k: c for k, c in self.watchers.get(oid, {}).items()
-                    if not getattr(c, "closed", False)}
+            live = {k: w for k, w in self.watchers.get(oid, {}).items()
+                    if not getattr(w.get("conn"), "closed", False)
+                    or w.get("addr")}
             self.watchers[oid] = live
             return {"ok": True,
                     "watchers": [[cl, ck] for cl, ck in live]}
@@ -869,27 +917,40 @@ class PG:
         if name == "notify":
             payload = bytes(op.get("data", b""))
             timeout = float(op.get("timeout", 5.0))
-            targets = [(k, c) for k, c in
-                       self.watchers.get(oid, {}).items()
-                       if not getattr(c, "closed", False)]
+            targets = list(self.watchers.get(oid, {}).items())
             acks: list[list] = []
             missed: list[list] = []
             waiting = []
-            for (cl, ck), wconn in targets:
+            dropped = False
+            for (cl, ck), w in targets:
                 nid = f"{self.pgid}:{oid}:{next(self.osd._notify_serial)}"
                 fut = asyncio.get_event_loop().create_future()
                 self.osd._notify_waiters[nid] = fut
+                note = Message(
+                    "watch_notify",
+                    {"pool": self.pool.pool_id, "oid": oid,
+                     "notify_id": nid, "cookie": ck},
+                    segments=[payload])
                 try:
-                    await wconn.send(Message(
-                        "watch_notify",
-                        {"pool": self.pool.pool_id, "oid": oid,
-                         "notify_id": nid, "cookie": ck},
-                        segments=[payload]))
+                    wconn = w.get("conn")
+                    if wconn is not None \
+                            and not getattr(wconn, "closed", False):
+                        await wconn.send(note)
+                    elif w.get("addr"):
+                        # failover-reloaded watcher: no live conn yet;
+                        # dial the client's listening address
+                        await self.osd.msgr.send(
+                            tuple(w["addr"]), cl, note)
+                    else:
+                        raise ConnectionError("no path to watcher")
                     waiting.append(([cl, ck], nid, fut))
                 except (ConnectionError, OSError):
                     self.osd._notify_waiters.pop(nid, None)
                     self.watchers.get(oid, {}).pop((cl, ck), None)
+                    dropped = True
                     missed.append([cl, ck])
+            if dropped:
+                await self._persist_watchers(oid)
             # the ACK WAIT must not run under the PG lock: a watcher
             # whose callback writes to this PG would deadlock until the
             # timeout, and every client op would stall behind it.  The

@@ -210,7 +210,7 @@ class MirrorDaemon:
         self._task: asyncio.Task | None = None
 
     async def sync_all(self) -> dict:
-        enabled = await mirror_enabled(self.src)
+        enabled = await mirror_enabled(self.src, mode="snapshot")
         for name in enabled:
             try:
                 self.stats[name] = await mirror_sync(self.src, self.dst,
@@ -279,6 +279,10 @@ async def journal_bootstrap(src_ioctx, dst_ioctx, image_name: str,
                 raise
         dst = await Image.open(dst_ioctx, image_name,
                                exclusive=False)
+        # replicated bytes must NOT re-journal on the secondary: its
+        # journal has no consumers (untrimmable growth) and a later
+        # promotion would replay the whole copy again
+        dst.journal = None
         try:
             if dst.meta["size"] != src.meta["size"]:
                 await dst.resize(src.meta["size"])
@@ -322,21 +326,10 @@ async def journal_replay_once(src_ioctx, dst_ioctx, image_name: str,
         dst = await Image.open(dst_ioctx, image_name, exclusive=False)
         try:
             for seq, ev, payload in entries:
-                op = ev.get("op")
-                if op == "write":
-                    if ev["off"] + len(payload) > dst.meta["size"]:
-                        await dst.resize(ev["off"] + len(payload))
-                    await dst.write(ev["off"], payload)
-                elif op == "discard":
-                    await dst.discard(ev["off"], ev["len"])
-                elif op == "resize":
-                    await dst.resize(ev["size"])
-                elif op == "snap_create":
-                    try:
-                        await dst.create_snap(ev["name"])
-                    except RbdError as e:
-                        if e.errno_name != "EEXIST":
-                            raise
+                # the image's own replay helper: one dispatch switch
+                # for primary catch-up and mirror replay, and it masks
+                # dst.journal so nothing re-journals on the secondary
+                await dst._apply_journal_event(ev, payload)
                 pos = seq
         finally:
             await dst.close()

@@ -323,6 +323,8 @@ class Image:
         try:
             op = ev.get("op")
             if op == "write":
+                if ev["off"] + len(payload) > self.meta["size"]:
+                    await self.resize(ev["off"] + len(payload))
                 await self.write(ev["off"], payload)
             elif op == "discard":
                 await self.discard(ev["off"], ev["len"])

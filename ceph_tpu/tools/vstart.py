@@ -5,6 +5,15 @@ SIGINT/SIGTERM.  With --store-dir, OSDs use SQLite-backed DBStores so
 the cluster survives restarts (crash-recovery via WAL).
 
     python -m ceph_tpu.tools.vstart --osds 3 --mon-port 6789
+
+Multi-process deployments (the qa/standalone ceph-helpers.sh shape)
+run one DAEMON per process instead:
+
+    python -m ceph_tpu.tools.vstart --role mon --mon-port 6789 \
+        --store-dir /var/lib/c1
+    python -m ceph_tpu.tools.vstart --role osd \
+        --mon-addr 127.0.0.1:6789 --osd-index 0 --store block \
+        --store-dir /var/lib/c1
 """
 
 from __future__ import annotations
@@ -18,6 +27,58 @@ import sys
 from ..mon import Monitor
 from ..os.store import DBStore, MemStore
 from ..osd import OSD
+
+
+def _make_store(args, name: str):
+    if not args.store_dir or args.store == "mem":
+        return MemStore()
+    if args.store == "block":
+        from ..os.blockstore import BlockStore
+        return BlockStore(os.path.join(args.store_dir, name))
+    return DBStore(os.path.join(args.store_dir, f"{name}.db"))
+
+
+async def _serve_until_signal(banner: str) -> None:
+    print(banner, flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+
+async def run_mon(args) -> None:
+    """One monitor in THIS process (multi-process deployment role)."""
+    mon = Monitor(rank=0,
+                  store_path=(os.path.join(args.store_dir, "mon.db")
+                              if args.store_dir else ":memory:"),
+                  config={"mon_osd_min_down_reporters":
+                          args.min_down_reporters},
+                  admin_socket_path=(
+                      os.path.join(args.asok_dir or args.store_dir,
+                                   "mon.0.asok")
+                      if (args.asok_dir or args.store_dir) else None))
+    addr = await mon.start(port=args.mon_port)
+    mon.peer_addrs = [addr]
+    await _serve_until_signal(f"mon.0 at {addr[0]}:{addr[1]}")
+    await mon.stop()
+
+
+async def run_osd(args) -> None:
+    """One OSD in THIS process, booting to --mon-addr."""
+    host, _, port = args.mon_addr.partition(":")
+    store = _make_store(args, f"osd{args.osd_index}")
+    asok = args.asok_dir or args.store_dir
+    osd = OSD(host=f"host{args.osd_index % args.hosts}", store=store,
+              config={"osd_heartbeat_interval": 0.5,
+                      "osd_heartbeat_grace": 4.0},
+              admin_socket_path=(
+                  os.path.join(asok, f"osd.{args.osd_index}.asok")
+                  if asok else None))
+    wid = await osd.start((host, int(port)))
+    await _serve_until_signal(
+        f"osd.{wid} up ({args.store} store)")
+    await osd.stop()
 
 
 async def run_cluster(args) -> None:
@@ -35,10 +96,7 @@ async def run_cluster(args) -> None:
     print(f"mon.0 at {addr[0]}:{addr[1]}", flush=True)
     osds = []
     for i in range(args.osds):
-        if args.store_dir:
-            store = DBStore(os.path.join(args.store_dir, f"osd{i}.db"))
-        else:
-            store = MemStore()
+        store = _make_store(args, f"osd{i}")
         osd = OSD(host=f"host{i % args.hosts}", store=store,
                   config={"osd_heartbeat_interval": 0.5,
                           "osd_heartbeat_grace": 4.0},
@@ -99,11 +157,25 @@ def main(argv=None) -> int:
                    help="start a mgr daemon (balancer active; on by "
                         "default, disable with --no-mgr)")
     p.add_argument("--no-mgr", dest="mgr", action="store_false")
+    p.add_argument("--role", choices=("all", "mon", "osd"),
+                   default="all",
+                   help="run the whole cluster in-process (all) or "
+                        "ONE daemon per process (mon/osd)")
+    p.add_argument("--mon-addr", default=None,
+                   help="mon address for --role osd (host:port)")
+    p.add_argument("--osd-index", type=int, default=0)
+    p.add_argument("--store", choices=("mem", "db", "block"),
+                   default="db",
+                   help="store backend when --store-dir is set")
     args = p.parse_args(argv)
     if args.store_dir:
         os.makedirs(args.store_dir, exist_ok=True)
+    if args.role == "osd" and not args.mon_addr:
+        p.error("--role osd requires --mon-addr host:port")
+    runner = {"all": run_cluster, "mon": run_mon,
+              "osd": run_osd}[args.role]
     try:
-        asyncio.run(run_cluster(args))
+        asyncio.run(runner(args))
     except KeyboardInterrupt:
         pass
     return 0

@@ -1,0 +1,155 @@
+"""cephx-style ticket auth with rotating service keys.
+
+The round-3 review's finding: a static pre-shared key means a leaked
+key is forever.  This is the reference protocol's shape compressed
+(src/auth/cephx/): clients prove knowledge of their ENTITY key to the
+mon and receive a TICKET -- a service-key-encrypted blob carrying a
+fresh session key and an expiry -- plus the session key encrypted
+under their own key.  Services never learn entity keys; they validate
+tickets with ROTATING service secrets (current + previous generation,
+src/auth/RotatingKeyRing.h), so a stolen service key ages out in two
+rotations and a stolen ticket dies at its expiry.
+
+AES-GCM does the sealing (the reference uses AES-CBC+hmac; GCM is the
+modern equivalent of seal-with-integrity).  Entity keys are the hex
+strings the mon's AuthMonitor db already stores.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import time
+
+
+def _aes(key_material: bytes):
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    return AESGCM(hashlib.sha256(key_material).digest())
+
+
+def seal(key_material: bytes, obj: dict) -> str:
+    nonce = os.urandom(12)
+    ct = _aes(key_material).encrypt(nonce,
+                                    json.dumps(obj).encode(), b"")
+    return (nonce + ct).hex()
+
+
+def unseal(key_material: bytes, blob_hex: str) -> dict:
+    raw = bytes.fromhex(blob_hex)
+    out = _aes(key_material).decrypt(raw[:12], raw[12:], b"")
+    return json.loads(out)
+
+
+class CephxError(Exception):
+    pass
+
+
+class RotatingKeys:
+    """Two live generations of one service's secret; the older one
+    keeps in-flight tickets valid across a rotation."""
+
+    def __init__(self, ttl: float = 3600.0) -> None:
+        self.ttl = ttl
+        self.gen = 0
+        self.keys: dict[int, dict] = {}
+        self._rotate(time.time())
+
+    def _rotate(self, now: float) -> None:
+        self.gen += 1
+        self.keys[self.gen] = {"key": os.urandom(32).hex(),
+                               "created": now}
+        for g in [g for g in self.keys if g < self.gen - 1]:
+            del self.keys[g]
+
+    def rotate_if_due(self, now: float | None = None) -> bool:
+        now = time.time() if now is None else now
+        if now - self.keys[self.gen]["created"] >= self.ttl:
+            self._rotate(now)
+            return True
+        return False
+
+    def current(self) -> tuple[int, bytes]:
+        return self.gen, bytes.fromhex(self.keys[self.gen]["key"])
+
+    def lookup(self, gen: int) -> bytes:
+        entry = self.keys.get(gen)
+        if entry is None:
+            raise CephxError(f"service key generation {gen} retired")
+        return bytes.fromhex(entry["key"])
+
+    def to_dict(self) -> dict:
+        return {"gen": self.gen,
+                "keys": {str(g): dict(e)
+                         for g, e in self.keys.items()}}
+
+    @classmethod
+    def from_dict(cls, d: dict, ttl: float = 3600.0) -> "RotatingKeys":
+        rk = cls.__new__(cls)
+        rk.ttl = ttl
+        rk.gen = int(d["gen"])
+        rk.keys = {int(g): dict(e) for g, e in d["keys"].items()}
+        return rk
+
+
+class CephxAuthority:
+    """Mon-side ticket issuer (CephxServiceHandler)."""
+
+    def __init__(self, ttl: float = 3600.0,
+                 ticket_ttl: float = 600.0) -> None:
+        self.ttl = ttl
+        self.ticket_ttl = ticket_ttl
+        self.rotating: dict[str, RotatingKeys] = {}
+
+    def service_keys(self, service: str) -> RotatingKeys:
+        rk = self.rotating.get(service)
+        if rk is None:
+            rk = self.rotating[service] = RotatingKeys(self.ttl)
+        rk.rotate_if_due()
+        return rk
+
+    def verify_entity_proof(self, entity_key_hex: str, nonce: str,
+                            proof: str) -> None:
+        want = hmac.new(bytes.fromhex(entity_key_hex),
+                        bytes.fromhex(nonce),
+                        hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, proof):
+            raise CephxError("entity proof mismatch")
+
+    def issue_ticket(self, entity: str, entity_key_hex: str,
+                     service: str,
+                     now: float | None = None) -> dict:
+        """Package for the client: the service-sealed ticket (opaque
+        to the client) + the session key sealed under the CLIENT's
+        entity key."""
+        now = time.time() if now is None else now
+        rk = self.service_keys(service)
+        gen, skey = rk.current()
+        session_key = os.urandom(32).hex()
+        expires = now + self.ticket_ttl
+        ticket = seal(skey, {"entity": entity,
+                             "session_key": session_key,
+                             "expires": expires, "gen": gen})
+        for_client = seal(bytes.fromhex(entity_key_hex),
+                          {"session_key": session_key,
+                           "expires": expires})
+        return {"service": service, "gen": gen, "ticket": ticket,
+                "session": for_client, "expires": expires}
+
+
+def validate_ticket(rotating: RotatingKeys, gen: int, ticket_hex: str,
+                    now: float | None = None) -> dict:
+    """Service side: unseal with the rotating key of that generation;
+    reject expired tickets.  Returns {entity, session_key, expires}."""
+    now = time.time() if now is None else now
+    try:
+        ticket = unseal(rotating.lookup(int(gen)), ticket_hex)
+    except CephxError:
+        raise
+    except Exception as e:
+        raise CephxError(f"ticket unseal failed: {type(e).__name__}") \
+            from e
+    if ticket["expires"] < now:
+        raise CephxError("ticket expired")
+    return ticket

@@ -693,6 +693,76 @@ class Monitor:
                                  "tid": msg.data.get("tid"),
                                  "epoch": self.osdmap.epoch}))
 
+    # -- cephx (AuthMonitor ticket service) ----------------------------------
+    @property
+    def cephx(self):
+        from ..common.cephx import CephxAuthority
+        if getattr(self, "_cephx", None) is None:
+            self._cephx = CephxAuthority(
+                ttl=float(self.config.get("auth_service_ticket_ttl",
+                                          3600.0)),
+                ticket_ttl=float(self.config.get("auth_ticket_ttl",
+                                                 600.0)))
+            # replicated rotating keys (peons validate/restore from
+            # the paxos log via services.apply)
+            for svc, d in getattr(self.services, "cephx_keys",
+                                  {}).items():
+                from ..common.cephx import RotatingKeys
+                self._cephx.rotating[svc] = RotatingKeys.from_dict(
+                    d, self._cephx.ttl)
+        return self._cephx
+
+    async def _persist_rotating(self, service: str) -> None:
+        rk = self.cephx.rotating[service]
+        await self.propose_service_kv(
+            "cephx", {service: json.dumps(rk.to_dict())})
+
+    async def _h_auth_get_ticket(self, conn, msg) -> None:
+        """CephxServiceHandler: a client proves its entity key and
+        receives a session ticket for a service."""
+        from ..common.cephx import CephxError
+        d = msg.data
+        entity = d["entity"]
+        rec = self.services.auth_db.get(entity)
+        try:
+            if rec is None:
+                raise CephxError(f"unknown entity {entity}")
+            self.cephx.verify_entity_proof(rec["key"], d["nonce"],
+                                           d["proof"])
+            before = self.cephx.rotating.get(d["service"])
+            gen_before = before.gen if before else 0
+            pkg = self.cephx.issue_ticket(entity, rec["key"],
+                                          d["service"])
+            if self.is_leader and                     self.cephx.rotating[d["service"]].gen != gen_before:
+                await self._persist_rotating(d["service"])
+            await conn.send(Message("auth_ticket_reply", pkg))
+        except CephxError as e:
+            await conn.send(Message("auth_ticket_reply",
+                                    {"err": str(e)}))
+
+    async def _h_auth_rotating(self, conn, msg) -> None:
+        """A service daemon fetches its rotating validation keys,
+        proving its own entity key; keys ship sealed under it."""
+        from ..common.cephx import CephxError, seal
+        d = msg.data
+        entity = d["entity"]
+        rec = self.services.auth_db.get(entity)
+        try:
+            if rec is None:
+                raise CephxError(f"unknown entity {entity}")
+            if not entity.startswith(f"{d['service']}."):
+                raise CephxError(
+                    f"{entity} may not read {d['service']} keys")
+            self.cephx.verify_entity_proof(rec["key"], d["nonce"],
+                                           d["proof"])
+            rk = self.cephx.service_keys(d["service"])
+            blob = seal(bytes.fromhex(rec["key"]), rk.to_dict())
+            await conn.send(Message("auth_rotating_reply",
+                                    {"sealed": blob}))
+        except CephxError as e:
+            await conn.send(Message("auth_rotating_reply",
+                                    {"err": str(e)}))
+
     # -- MDSMonitor (FSMap) --------------------------------------------------
     MDS_BEACON_GRACE = 8.0
 

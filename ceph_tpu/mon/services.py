@@ -47,6 +47,8 @@ class MonServices:
         # paxos like every service; beacon liveness is in-memory on
         # the leader (mds_last_beacon on the Monitor).
         self.fsmap: dict = {"epoch": 0, "active": None, "standbys": []}
+        # replicated cephx rotating service keys: service -> dict
+        self.cephx_keys: dict[str, dict] = {}
 
     # -- replication hook ----------------------------------------------------
     def apply(self, service_kv: dict) -> None:
@@ -63,6 +65,15 @@ class MonServices:
             else:
                 self.auth_db[entity] = json.loads(val) \
                     if isinstance(val, str) else val
+        for svc, val in service_kv.get("cephx", {}).items():
+            self.cephx_keys[svc] = (json.loads(val)
+                                    if isinstance(val, str) else val)
+            # a live authority must see replicated rotations too
+            mon = self.mon
+            if getattr(mon, "_cephx", None) is not None:
+                from ..common.cephx import RotatingKeys
+                mon._cephx.rotating[svc] = RotatingKeys.from_dict(
+                    self.cephx_keys[svc], mon._cephx.ttl)
         fsval = service_kv.get("fsmap", {}).get("map")
         if fsval is not None:
             self.fsmap = (json.loads(fsval)

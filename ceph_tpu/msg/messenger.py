@@ -233,6 +233,15 @@ class Messenger:
         # incarnation resets the replay-dedup session, a reconnect of
         # the same incarnation resumes it
         self.incarnation = os.urandom(8).hex()
+        # cephx ticket auth (optional, composes with/replaces the
+        # static PSK): a CLIENT sets `ticket` ({"gen", "ticket",
+        # "session_key"}) and proves the session key; a SERVER sets
+        # `ticket_validator(gen, blob) -> session_key bytes` (raises
+        # to reject).  The ticket's session key becomes the
+        # connection secret for negotiation MAC + secure-mode keys,
+        # so a leaked PSK stops being forever (round-3 review).
+        self.ticket: dict | None = None
+        self.ticket_validator = None
         self.dispatchers: list[Dispatcher] = []
         # one connection per peer per DIRECTION: simultaneous cross-
         # connects between two daemons are legal and never race over a
@@ -299,23 +308,25 @@ class Messenger:
         conn._read_task = asyncio.ensure_future(self._read_loop(conn))
 
     # -- handshake (HMAC challenge, cephx-lite) ------------------------------
-    def _session_keys(self, nonce: bytes, cnonce: bytes, salt: bytes):
+    def _session_keys(self, nonce: bytes, cnonce: bytes, salt: bytes,
+                      secret: bytes | None = None):
         """Per-direction session keys from the full transcript: server
         nonce + CLIENT nonce + salt (a replayed server hello cannot
         force key reuse -- the client's nonce is fresh), with a
         direction label (c2s/s2c) so the two streams never share a key
         (cephx-style session key into AES-GCM, crypto_onwire.cc)."""
         from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        secret = secret if secret is not None else self.secret
         base = nonce + cnonce + salt
 
         def key(label: bytes):
-            return AESGCM(hmac.new(self.secret,
+            return AESGCM(hmac.new(secret,
                                    b"ctv2-secure-" + label + base,
                                    hashlib.sha256).digest())
         return key(b"c2s"), key(b"s2c")
 
     def _nego_mac(self, nego: dict, nonce: bytes,
-                  cnonce: bytes) -> str:
+                  cnonce: bytes, secret: bytes | None = None) -> str:
         """Bind the negotiation to the shared secret: a MITM rewriting
         the plaintext nego blob (encryption downgrade) fails the MAC."""
         if self.secret is None:
@@ -323,7 +334,8 @@ class Messenger:
         blob = json.dumps({k: nego[k] for k in
                            ("compression", "secure", "salt")},
                           sort_keys=True).encode()
-        return hmac.new(self.secret, b"nego" + nonce + cnonce + blob,
+        secret = secret if secret is not None else self.secret
+        return hmac.new(secret, b"nego" + nonce + cnonce + blob,
                         hashlib.sha256).hexdigest()
 
     def _negotiate(self, offered: dict) -> dict:

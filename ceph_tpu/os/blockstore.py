@@ -40,6 +40,8 @@ from .transaction import Transaction
 BLOCK = 4096                     # allocation/checksum unit
 DEFERRED_MAX = 16 * BLOCK        # <=64 KiB writes take the WAL path
 WAL_CKPT_BYTES = 8 << 20         # checkpoint + truncate past this
+QUAR_MAX_BLOCKS = 4096           # force a checkpoint past 16 MiB of
+                                 # quarantined frees (space amp bound)
 REC_MAGIC = b"BSR1"
 
 
@@ -117,14 +119,13 @@ class BlockStore(ObjectStore):
     def __init__(self, path: str) -> None:
         self.path = path
         os.makedirs(path, exist_ok=True)
-        self.colls: dict[str, dict[str, _Onode]] = {}
-        self.csum: dict[int, int] = {}       # device blk -> crc32c
-        self.refcnt: dict[int, int] = {}     # shared blocks only (>1)
-        self.alloc = Allocator()
+        # colls / csum (device blk -> crc32c) / refcnt (shared blocks
+        # only) / alloc / _seq / _pending / _quarantine / _failed are
+        # disk-derived: (re)set in _reset_state at every mount
+        self._reset_state()
         self._block_fd = -1
         self._wal_fd = -1
         self._wal_size = 0
-        self._seq = 0
         self._mounted = False
         # kv-sync group commit: submitters enqueue (record, event) and
         # block; the flusher writes+fsyncs EVERYTHING queued in one go
@@ -136,17 +137,36 @@ class BlockStore(ObjectStore):
         # serializes apply+commit+checkpoint across submitter threads
         # (MemStore holds a lock for the same contract)
         self._txn_lock = threading.Lock()
-        # deferred writes staged this txn but not yet on the device:
-        # later ops in the SAME txn must read through this overlay
-        self._pending: dict[int, bytes] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def _f(self, name: str) -> str:
         return os.path.join(self.path, name)
 
+    def _reset_state(self) -> None:
+        """In-memory state that must be rebuilt from disk truth at
+        every mount (a prior failed txn leaves nothing behind)."""
+        self.colls: dict[str, dict[str, _Onode]] = {}
+        self.csum: dict[int, int] = {}      # device blk -> crc32c
+        self.refcnt: dict[int, int] = {}    # shared blocks only (>1)
+        self.alloc = Allocator()
+        self._seq = 0
+        # deferred writes staged this txn but not yet on the device:
+        # later ops in the SAME txn must read through this overlay
+        self._pending: dict[int, bytes] = {}
+        # freed blocks quarantined until the WAL is truncated: a live
+        # WAL record may still carry a deferred payload for them, and
+        # replay after a crash would pwrite that stale payload over
+        # whatever a reallocation put there (BlueStore holds frees
+        # until the kv log no longer references the extent)
+        self._quarantine: set[int] = set()
+        # a txn that died mid-commit leaves memory inconsistent with
+        # the log: refuse further work, like BlueStore's abort path
+        self._failed = False
+
     def mount(self) -> None:
         if self._mounted:
             return
+        self._reset_state()
         self._block_fd = os.open(self._f("block"),
                                  os.O_RDWR | os.O_CREAT, 0o644)
         self._load_checkpoint()
@@ -161,6 +181,11 @@ class BlockStore(ObjectStore):
             os.ftruncate(self._wal_fd, good)
             os.fsync(self._wal_fd)
         self._wal_size = good
+        if good > 0:
+            # checkpoint the replayed state so the WAL holds no stale
+            # deferred payloads: only then is the rebuilt free list
+            # safe to allocate from (see _quarantine)
+            self._checkpoint()
         self._stop = False
         self._flusher = threading.Thread(target=self._kv_sync,
                                          daemon=True)
@@ -174,14 +199,25 @@ class BlockStore(ObjectStore):
             self._stop = True
             self._submit_cv.notify()
         self._flusher.join()
-        self._checkpoint()
+        if not self._failed:
+            self._checkpoint()
+        # on failure: do NOT checkpoint -- the in-memory state is
+        # half-applied and the WAL (which never got the failed txn's
+        # record) is the only consistent truth; remount replays it
         os.close(self._wal_fd)
         os.close(self._block_fd)
         self._mounted = False
 
     def _ensure(self) -> None:
         if not self._mounted:
-            self.mount()
+            self.mount()        # resets a prior failure from disk
+            return
+        if self._failed:
+            # reads too: the in-memory maps may hold the half-applied
+            # txn (new csums over old device content), so serving them
+            # would misreport corruption or leak uncommitted state
+            raise IOError("blockstore failed mid-commit; "
+                          "remount required")
 
     # -- kv-sync flusher (group commit) --------------------------------------
     def _kv_sync(self) -> None:
@@ -231,15 +267,21 @@ class BlockStore(ObjectStore):
             elif op.coll not in pending:
                 raise KeyError(f"no collection {op.coll}")
         with self._txn_lock:
+            if self._failed:
+                raise IOError("blockstore failed mid-commit; "
+                              "remount required")
             try:
                 self._commit_locked(txn)
+            except BaseException:
+                self._failed = True
+                raise
             finally:
                 self._pending.clear()
 
     def _commit_locked(self, txn: Transaction) -> None:
         self._seq += 1
         delta: dict = {"seq": self._seq, "ops": []}
-        ctx = {"sync": False, "deferred": []}
+        ctx = {"sync": False, "deferred": [], "to_release": []}
         for op in txn.ops:
             self._apply_op(op, delta, ctx)
         if ctx["sync"]:
@@ -256,8 +298,10 @@ class BlockStore(ObjectStore):
         # caught up (exactly BlueStore's deferred ordering)
         for dev, content in ctx["deferred"]:
             os.pwrite(self._block_fd, content, dev * BLOCK)
+        self._quarantine.update(ctx["to_release"])
         self._pending.clear()
-        if self._wal_size > WAL_CKPT_BYTES:
+        if (self._wal_size > WAL_CKPT_BYTES
+                or len(self._quarantine) > QUAR_MAX_BLOCKS):
             self._checkpoint()
 
     # each ops entry in a delta is self-contained for idempotent
@@ -271,7 +315,7 @@ class BlockStore(ObjectStore):
             delta["ops"].append({"op": "mkcoll", "c": c})
         elif op.op == "rmcoll":
             for o in list(self.colls.get(c, {})):
-                self._free_object(c, o)
+                self._free_object(c, o, ctx)
             self.colls.pop(c, None)
             delta["ops"].append({"op": "rmcoll", "c": c})
         elif op.op == "touch":
@@ -285,10 +329,10 @@ class BlockStore(ObjectStore):
         elif op.op == "truncate":
             self._do_truncate(c, oid, a["size"], delta, ctx)
         elif op.op == "remove":
-            self._free_object(c, oid)
+            self._free_object(c, oid, ctx)
             delta["ops"].append({"op": "remove", "c": c, "o": oid})
         elif op.op == "clone":
-            self._do_clone(c, oid, a["dst"], delta)
+            self._do_clone(c, oid, a["dst"], delta, ctx)
         elif op.op == "setattr":
             on = self._onode(c, oid, create=True)
             on.xattrs[a["name"]] = a["value"]
@@ -340,14 +384,19 @@ class BlockStore(ObjectStore):
                     f"checksum mismatch on device block {dev_blk}")
         return buf
 
-    def _deref(self, dev_blk: int) -> None:
+    def _deref(self, dev_blk: int, ctx: dict) -> None:
         n = self.refcnt.get(dev_blk, 1)
         if n > 1:
             self.refcnt[dev_blk] = n - 1
         else:
             self.refcnt.pop(dev_blk, None)
             self.csum.pop(dev_blk, None)
-            self.alloc.release([dev_blk])
+            # never straight back to the allocator: a live WAL record
+            # (this txn's or an earlier uncheckpointed one) may carry a
+            # deferred payload for this block, and replay would smear
+            # it over whatever a reallocation wrote here.  Quarantined
+            # until the WAL is truncated (_checkpoint).
+            ctx["to_release"].append(dev_blk)
 
     def _do_write(self, c: str, oid: str, offset: int, data: bytes,
                   delta: dict, ctx: dict) -> None:
@@ -384,7 +433,7 @@ class BlockStore(ObjectStore):
                 # for blocks a clone still references)
                 dev = self.alloc.alloc(1)[0]
                 if old_dev is not None:
-                    self._deref(old_dev)
+                    self._deref(old_dev, ctx)
             if deferred and dev == old_dev:
                 # in-place overwrite: must not hit the device until
                 # the WAL record is durable
@@ -414,7 +463,7 @@ class BlockStore(ObjectStore):
         on = self._onode(c, oid, create=True)
         keep = (size + BLOCK - 1) // BLOCK
         for lb in [b for b in on.blocks if b >= keep]:
-            self._deref(on.blocks.pop(lb))
+            self._deref(on.blocks.pop(lb), ctx)
         if size % BLOCK and size < on.size \
                 and size // BLOCK in on.blocks:
             # zero the tail of the last kept block through the write
@@ -427,11 +476,11 @@ class BlockStore(ObjectStore):
                              "size": size})
 
     def _do_clone(self, c: str, src: str, dst: str,
-                  delta: dict) -> None:
+                  delta: dict, ctx: dict) -> None:
         if src not in self.colls.get(c, {}):
             return                      # MemStore contract: no-op
         son = self._onode(c, src)
-        self._free_object(c, dst)
+        self._free_object(c, dst, ctx)
         don = self._onode(c, dst, create=True)
         don.size = son.size
         don.blocks = dict(son.blocks)
@@ -442,11 +491,11 @@ class BlockStore(ObjectStore):
         delta["ops"].append({"op": "clone", "c": c, "o": src,
                              "dst": dst})
 
-    def _free_object(self, c: str, oid: str) -> None:
+    def _free_object(self, c: str, oid: str, ctx: dict) -> None:
         on = self.colls.get(c, {}).pop(oid, None)
         if on is not None:
             for dev in on.blocks.values():
-                self._deref(dev)
+                self._deref(dev, ctx)
 
     # -- replay / checkpoint --------------------------------------------------
     def _replay_op(self, d: dict) -> None:
@@ -564,6 +613,11 @@ class BlockStore(ObjectStore):
         else:
             with open(self._f("wal"), "wb"):
                 pass
+        # the WAL no longer references any freed block: quarantined
+        # frees are finally safe to hand back to the allocator
+        if self._quarantine:
+            self.alloc.release(self._quarantine)
+            self._quarantine.clear()
 
     def _load_checkpoint(self) -> None:
         try:

@@ -48,13 +48,19 @@ def test_basic_rw_and_remount(tmp_path):
     bs2.umount()
 
 
-def test_allocator_reuses_freed_blocks(tmp_path):
+def test_allocator_reuses_freed_blocks_after_checkpoint(tmp_path):
+    """Freed blocks are quarantined while any WAL record could still
+    reference them; once the WAL is checkpointed (truncated) they go
+    back to the allocator and the device stops growing."""
     bs = mk(tmp_path / "s")
     bs.queue_transaction(Transaction().create_collection("c"))
     big = os.urandom(DEFERRED_MAX + BLOCK)     # forces redirect path
     w(bs, "c", "a", 0, big)
     high_after_first = bs.alloc.high
     bs.queue_transaction(Transaction().remove("c", "a"))
+    assert bs._quarantine                      # held, not yet free
+    bs._checkpoint()                           # WAL truncated -> safe
+    assert not bs._quarantine
     w(bs, "c", "b", 0, big)
     # freed blocks were reused: the device did not grow
     assert bs.alloc.high == high_after_first
@@ -263,3 +269,107 @@ def test_torn_tail_truncated_at_mount_so_later_writes_survive(tmp_path):
     assert bs3.read("c", "kept") == b"intact"
     assert bs3.read("c", "after") == b"post-tear write"
     bs3.umount()
+
+
+def test_overwrite_crash_preserves_committed_multiblock_object(tmp_path):
+    """Freed device blocks must not return to the allocator until the
+    txn's WAL record is durable: during a large redirect-on-write
+    overwrite, a block freed for logical block N could otherwise be
+    re-allocated to logical block N+1 of the SAME txn and overwritten
+    with new data before the record commits -- a crash then destroys
+    the previously committed object (BlueStore defers release to txn
+    finish for exactly this reason)."""
+    path = str(tmp_path / "s")
+    bs = mk(path)
+    bs.queue_transaction(Transaction().create_collection("c"))
+    old = os.urandom(DEFERRED_MAX + 4 * BLOCK)   # redirect, multi-block
+    w(bs, "c", "victim", 0, old)                 # committed, durable
+
+    def boom(rec):
+        raise RuntimeError("crash before log fsync")
+    bs._wal_commit = boom
+    with pytest.raises(RuntimeError):
+        w(bs, "c", "victim", 0, os.urandom(len(old)))
+    os.close(bs._block_fd)
+
+    bs2 = BlockStore(path)
+    bs2.mount()
+    assert bs2.read("c", "victim") == old        # csum-verified
+    bs2.umount()
+
+
+def test_remove_then_write_crash_preserves_removed_object(tmp_path):
+    """Same hazard via remove: a txn that removes an object and writes
+    a new one must not let the new data land on the removed object's
+    blocks before the WAL record commits."""
+    path = str(tmp_path / "s")
+    bs = mk(path)
+    bs.queue_transaction(Transaction().create_collection("c"))
+    old = os.urandom(DEFERRED_MAX + 4 * BLOCK)
+    w(bs, "c", "victim", 0, old)
+
+    def boom(rec):
+        raise RuntimeError("crash before log fsync")
+    bs._wal_commit = boom
+    t = Transaction().remove("c", "victim").write(
+        "c", "fresh", 0, os.urandom(len(old)))
+    with pytest.raises(RuntimeError):
+        bs.queue_transaction(t)
+    os.close(bs._block_fd)
+
+    bs2 = BlockStore(path)
+    bs2.mount()
+    assert bs2.read("c", "victim") == old
+    bs2.umount()
+
+
+def test_stale_deferred_payload_never_replays_over_reallocated_block(
+        tmp_path):
+    """Cross-txn replay hazard: txn T1 leaves a deferred payload for
+    block B in the WAL; T2 frees B; if B were reallocated to a later
+    NON-deferred write (whose replay relies on device content), a
+    crash-replay would smear T1's stale payload over it.  Quarantine
+    must keep B out of the allocator until the WAL is truncated."""
+    path = str(tmp_path / "s")
+    bs = mk(path)
+    bs.queue_transaction(Transaction().create_collection("c"))
+    w(bs, "c", "small", 0, b"A" * 100)           # allocates B
+    w(bs, "c", "small", 0, b"B" * 100)           # T1: deferred payload
+    devs = set(bs.colls["c"]["small"].blocks.values())
+    bs.queue_transaction(Transaction().remove("c", "small"))  # T2
+    big = os.urandom(DEFERRED_MAX + BLOCK)
+    w(bs, "c", "big", 0, big)                    # T3: redirect write
+    assert not devs & set(bs.colls["c"]["big"].blocks.values()), \
+        "freed block with a live WAL payload was reallocated"
+    # crash (no checkpoint), remount: replay must leave big intact
+    os.close(bs._block_fd)
+    bs2 = BlockStore(path)
+    bs2.mount()
+    assert bs2.read("c", "big") == big
+    bs2.umount()
+
+
+def test_failed_txn_umount_remount_recovers_committed_state(tmp_path):
+    """A txn that dies mid-commit poisons the store; a normal umount
+    must NOT checkpoint the half-applied memory state, and remount
+    must rebuild purely from ckpt+WAL (the failed txn never logged a
+    record, so it simply never happened)."""
+    path = str(tmp_path / "s")
+    bs = mk(path)
+    bs.queue_transaction(Transaction().create_collection("c"))
+    w(bs, "c", "a", 0, b"GOOD" * 200)
+
+    def boom(rec):
+        raise RuntimeError("commit failure")
+    bs._wal_commit = boom
+    with pytest.raises(RuntimeError):
+        w(bs, "c", "a", 0, b"EVIL" * 200)
+    with pytest.raises(IOError, match="remount"):
+        w(bs, "c", "a", 0, b"more")          # poisoned: refuses work
+    bs._wal_commit = BlockStore._wal_commit.__get__(bs)
+    bs.umount()                              # must not persist EVIL
+    bs.mount()                               # same instance remount
+    assert bs.read("c", "a") == b"GOOD" * 200
+    w(bs, "c", "a", 0, b"NEXT" * 200)        # recovered: writable
+    assert bs.read("c", "a") == b"NEXT" * 200
+    bs.umount()

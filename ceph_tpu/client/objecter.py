@@ -48,6 +48,37 @@ class Objecter:
     async def shutdown(self) -> None:
         await self.msgr.shutdown()
 
+    # -- cephx ---------------------------------------------------------------
+    async def authenticate(self, entity: str, key_hex: str,
+                           services: tuple = ("osd",)) -> None:
+        """Prove our entity key to the mon and hold live tickets for
+        the given service classes; OSD connections then authenticate
+        with the ticket's session key instead of the cluster PSK
+        (CephxClientHandler role)."""
+        from ..common.cephx import fetch_ticket
+        self._auth = (entity, key_hex, tuple(services))
+        for svc in services:
+            await fetch_ticket(self.msgr, self.mon_addr, entity,
+                               key_hex, svc)
+
+    async def _maybe_refresh_tickets(self) -> None:
+        """Re-fetch any ticket at (or within 30s of) expiry so long-
+        lived clients ride rotations without a failed handshake."""
+        auth = getattr(self, "_auth", None)
+        if auth is None:
+            return
+        import time as _time
+        from ..common.cephx import fetch_ticket
+        entity, key_hex, services = auth
+        for svc in services:
+            t = self.msgr.tickets.get(svc)
+            if t is None or t["expires"] - _time.time() < 30.0:
+                try:
+                    await fetch_ticket(self.msgr, self.mon_addr,
+                                       entity, key_hex, svc)
+                except Exception:
+                    pass         # retried on the next op
+
     async def _refresh_map(self, timeout: float = 10) -> None:
         q: asyncio.Queue = asyncio.Queue()
 
@@ -189,6 +220,7 @@ class Objecter:
         # (osd_reqid_t semantics)
         reqid = [f"{self.msgr.name}:{self.msgr.incarnation}",
                  next(self._reqid_serial)]
+        await self._maybe_refresh_tickets()
         while loop.time() < deadline:
             pgid, primary = self.calc_target(pool_id, oid, nspace, ps=ps)
             if primary is None:

@@ -51,6 +51,11 @@ class Rados:
         self.connected = True
         return self
 
+    async def authenticate(self, entity: str, key_hex: str,
+                           services: tuple = ("osd",)) -> None:
+        """cephx: hold live service tickets (see Objecter.authenticate)."""
+        await self.objecter.authenticate(entity, key_hex, services)
+
     async def shutdown(self) -> None:
         await self.objecter.shutdown()
         self.connected = False

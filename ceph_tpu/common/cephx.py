@@ -138,6 +138,83 @@ class CephxAuthority:
                 "session": for_client, "expires": expires}
 
 
+async def _auth_rpc(msgr, mon_addr, entity: str, key_hex: str,
+                    service: str, req_type: str, reply_type: str,
+                    mon_name: str, timeout: float) -> dict:
+    """One authenticated mon round trip shared by ticket and rotating-
+    key fetches: prove the entity key over a fresh nonce, correlate
+    the reply by tid."""
+    import asyncio
+    from ..msg import Message
+    q: asyncio.Queue = asyncio.Queue()
+    tid = os.urandom(8).hex()
+
+    async def d(conn, msg):
+        if msg.type == reply_type and msg.data.get("tid") == tid:
+            await q.put(msg.data)
+
+    msgr.add_dispatcher(d)
+    try:
+        nonce = os.urandom(16).hex()
+        proof = hmac.new(bytes.fromhex(key_hex),
+                         bytes.fromhex(nonce),
+                         hashlib.sha256).hexdigest()
+        await msgr.send(tuple(mon_addr), mon_name,
+                        Message(req_type,
+                                {"entity": entity, "service": service,
+                                 "nonce": nonce, "proof": proof,
+                                 "tid": tid}))
+        pkg = await asyncio.wait_for(q.get(), timeout)
+    finally:
+        msgr.dispatchers.remove(d)
+    if pkg.get("err"):
+        raise CephxError(pkg["err"])
+    return pkg
+
+
+async def fetch_ticket(msgr, mon_addr, entity: str, key_hex: str,
+                       service: str, mon_name: str = "mon.0",
+                       timeout: float = 10.0) -> dict:
+    """Client side (CephxClientHandler): prove the entity key to the
+    mon, receive a ticket package, unseal the session key, and install
+    the ticket on the messenger so connections to `service` daemons
+    authenticate with it instead of the PSK."""
+    pkg = await _auth_rpc(msgr, mon_addr, entity, key_hex, service,
+                          "auth_get_ticket", "auth_ticket_reply",
+                          mon_name, timeout)
+    sess = unseal(bytes.fromhex(key_hex), pkg["session"])
+    ticket = {"gen": pkg["gen"], "ticket": pkg["ticket"],
+              "session_key": sess["session_key"],
+              "expires": sess["expires"]}
+    msgr.tickets[service] = ticket
+    return ticket
+
+
+async def fetch_rotating(msgr, mon_addr, entity: str, key_hex: str,
+                         service: str, mon_name: str = "mon.0",
+                         timeout: float = 10.0) -> RotatingKeys:
+    """Daemon side: fetch the rotating validation keys for the
+    daemon's own service class (sealed under its entity key)."""
+    pkg = await _auth_rpc(msgr, mon_addr, entity, key_hex, service,
+                          "auth_rotating", "auth_rotating_reply",
+                          mon_name, timeout)
+    return RotatingKeys.from_dict(unseal(bytes.fromhex(key_hex),
+                                         pkg["sealed"]))
+
+
+def install_validator(msgr, holder: dict) -> None:
+    """Install a messenger ticket validator reading the CURRENT keys
+    from `holder["rk"]` (a mutable cell, so refreshes take effect
+    without re-installing).  Returns {entity, session_key bytes} so
+    the handshake can bind the connection's claimed name to the
+    ticket's entity (no cross-entity impersonation)."""
+    def validator(gen: int, blob_hex: str) -> dict:
+        info = validate_ticket(holder["rk"], gen, blob_hex)
+        return {"entity": info["entity"],
+                "session_key": bytes.fromhex(info["session_key"])}
+    msgr.ticket_validator = validator
+
+
 def validate_ticket(rotating: RotatingKeys, gen: int, ticket_hex: str,
                     now: float | None = None) -> dict:
     """Service side: unseal with the rotating key of that generation;

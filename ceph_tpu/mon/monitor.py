@@ -659,11 +659,16 @@ class Monitor:
         self.failure_reports.pop(osd, None)
         want = int(msg.data.get("want_up_thru", 0))
         if want and not self.is_leader and self.leader is not None:
-            # peon: forward to the leader (as _h_osd_failure does) so
-            # an OSD that can only reach us still gets its bump; the
-            # OSD's own retry loop handles the lost-reply case
+            # peon: forward to the leader (as _h_osd_failure does) and
+            # ROUTE THE REPLY BACK -- without the relay the OSD whose
+            # mon session landed here would never see osd_alive_reply
+            # and peering would stall on the request timeout
+            tid = f"alivefwd-{self.rank}-{time.monotonic_ns()}"
+            self._fwd_register(tid, conn, "osd_alive_reply")
             await self._send_mon(self.leader, Message(
-                "osd_alive", dict(msg.data)))
+                "osd_alive", {**msg.data,
+                              "fwd_tids": msg.data.get("fwd_tids", [])
+                              + [tid]}))
             return
         if want and self.is_leader and self.osdmap.is_up(osd):
             if self.osdmap.get_up_thru(osd) < want:
@@ -673,7 +678,14 @@ class Monitor:
             await conn.send(Message(
                 "osd_alive_reply",
                 {"osd_id": osd, "up_thru": self.osdmap.get_up_thru(osd),
-                 "epoch": self.osdmap.epoch}))
+                 "epoch": self.osdmap.epoch,
+                 **({"fwd_tids": msg.data["fwd_tids"]}
+                    if "fwd_tids" in msg.data else {})}))
+
+    async def _h_osd_alive_reply(self, conn, msg) -> None:
+        # mon side: a forwarded alive's reply coming back from the
+        # leader; relay to the waiting OSD connection
+        await self._auth_relay_reply(msg)
 
     # -- subscriptions ------------------------------------------------------
     async def _h_osd_pg_temp(self, conn, msg) -> None:
@@ -717,13 +729,69 @@ class Monitor:
         await self.propose_service_kv(
             "cephx", {service: json.dumps(rk.to_dict())})
 
+    async def _auth_forward(self, conn, msg, reply_type: str) -> None:
+        """Relay an auth request to the leader and route the reply
+        back to the original requester: only the LEADER may create or
+        rotate service keys (it alone persists them through paxos); a
+        peon minting keys locally would issue tickets no service can
+        validate (round-4 advisor finding).  Forwarding pushes onto a
+        fwd_tids STACK so a stale-leadership re-forward chain still
+        routes the reply hop by hop back to the origin."""
+        if self.leader is None or self.peer_addrs[self.leader] is None:
+            await conn.send(Message(
+                reply_type, {"err": "no quorum leader",
+                             **({"tid": msg.data["tid"]}
+                                if "tid" in msg.data else {})}))
+            return
+        tid = f"authfwd-{self.rank}-{time.monotonic_ns()}"
+        self._fwd_register(tid, conn, reply_type)
+        await self._send_mon(self.leader, Message(
+            msg.type, {**msg.data,
+                       "fwd_tids": msg.data.get("fwd_tids", [])
+                       + [tid]}))
+
+    def _fwd_register(self, tid: str, conn, reply_type: str) -> None:
+        """Track a forwarded request; sweep entries the leader never
+        answered (e.g. it crashed) so dead Connections don't pin."""
+        fwd = getattr(self, "_auth_fwd", None)
+        if fwd is None:
+            fwd = self._auth_fwd = {}
+        now = time.monotonic()
+        for k in [k for k, (_, _, dl) in fwd.items() if dl < now]:
+            del fwd[k]
+        fwd[tid] = (conn, reply_type, now + 30.0)
+
+    async def _h_auth_ticket_reply(self, conn, msg) -> None:
+        await self._auth_relay_reply(msg)
+
+    async def _h_auth_rotating_reply(self, conn, msg) -> None:
+        await self._auth_relay_reply(msg)
+
+    async def _auth_relay_reply(self, msg) -> None:
+        tids = list(msg.data.get("fwd_tids", []))
+        if not tids:
+            return
+        ent = getattr(self, "_auth_fwd", {}).pop(tids[-1], None)
+        if ent is not None:
+            c, reply_type, _ = ent
+            rest = tids[:-1]
+            await c.send(Message(
+                reply_type,
+                {**{k: v for k, v in msg.data.items()
+                    if k != "fwd_tids"},
+                 **({"fwd_tids": rest} if rest else {})}))
+
     async def _h_auth_get_ticket(self, conn, msg) -> None:
         """CephxServiceHandler: a client proves its entity key and
         receives a session ticket for a service."""
         from ..common.cephx import CephxError
+        if not self.is_leader:
+            await self._auth_forward(conn, msg, "auth_ticket_reply")
+            return
         d = msg.data
         entity = d["entity"]
         rec = self.services.auth_db.get(entity)
+        extra = {k: d[k] for k in ("fwd_tids", "tid") if k in d}
         try:
             if rec is None:
                 raise CephxError(f"unknown entity {entity}")
@@ -733,20 +801,25 @@ class Monitor:
             gen_before = before.gen if before else 0
             pkg = self.cephx.issue_ticket(entity, rec["key"],
                                           d["service"])
-            if self.is_leader and                     self.cephx.rotating[d["service"]].gen != gen_before:
+            if self.cephx.rotating[d["service"]].gen != gen_before:
                 await self._persist_rotating(d["service"])
-            await conn.send(Message("auth_ticket_reply", pkg))
+            await conn.send(Message("auth_ticket_reply",
+                                    {**pkg, **extra}))
         except CephxError as e:
             await conn.send(Message("auth_ticket_reply",
-                                    {"err": str(e)}))
+                                    {"err": str(e), **extra}))
 
     async def _h_auth_rotating(self, conn, msg) -> None:
         """A service daemon fetches its rotating validation keys,
         proving its own entity key; keys ship sealed under it."""
         from ..common.cephx import CephxError, seal
+        if not self.is_leader:
+            await self._auth_forward(conn, msg, "auth_rotating_reply")
+            return
         d = msg.data
         entity = d["entity"]
         rec = self.services.auth_db.get(entity)
+        extra = {k: d[k] for k in ("fwd_tids", "tid") if k in d}
         try:
             if rec is None:
                 raise CephxError(f"unknown entity {entity}")
@@ -755,13 +828,17 @@ class Monitor:
                     f"{entity} may not read {d['service']} keys")
             self.cephx.verify_entity_proof(rec["key"], d["nonce"],
                                            d["proof"])
+            before = self.cephx.rotating.get(d["service"])
+            gen_before = before.gen if before else 0
             rk = self.cephx.service_keys(d["service"])
+            if rk.gen != gen_before:
+                await self._persist_rotating(d["service"])
             blob = seal(bytes.fromhex(rec["key"]), rk.to_dict())
             await conn.send(Message("auth_rotating_reply",
-                                    {"sealed": blob}))
+                                    {"sealed": blob, **extra}))
         except CephxError as e:
             await conn.send(Message("auth_rotating_reply",
-                                    {"err": str(e)}))
+                                    {"err": str(e), **extra}))
 
     # -- MDSMonitor (FSMap) --------------------------------------------------
     MDS_BEACON_GRACE = 8.0

@@ -16,6 +16,7 @@ import hmac
 import json
 import os
 import struct
+import time
 from collections import deque
 from typing import Awaitable, Callable
 
@@ -24,6 +25,8 @@ from .message import Message, read_frame, wrap_frame
 Dispatcher = Callable[["Connection", Message], Awaitable[None]]
 
 HELLO_MAGIC = b"CTHL"
+HELLO_ACCEPTS_TICKETS = 0x01     # server can validate cephx tickets
+HELLO_REQUIRES_TICKET = 0x02     # server will NACK ticketless peers
 
 # flow-control policy (src/msg/Policy.h throttler analog): receivers ack
 # delivered seqs every ack_every messages or ack_bytes payload bytes --
@@ -221,9 +224,10 @@ class Messenger:
         # picks during the handshake (ProtocolV2 negotiation)
         self.compression = compression
         self.secure = secure
-        if secure and secret is None:
-            raise ValueError("secure mode needs a shared secret "
-                             "(the AEAD key derives from it)")
+        # secure mode needs a key source, but that can be the PSK OR a
+        # cephx ticket/validator installed after construction; a
+        # keyless endpoint that insists on secure simply refuses every
+        # connection at negotiation time
         self.max_unacked_msgs = max_unacked_msgs
         self.max_unacked_bytes = max_unacked_bytes
         self.ack_every = ack_every
@@ -233,15 +237,21 @@ class Messenger:
         # incarnation resets the replay-dedup session, a reconnect of
         # the same incarnation resumes it
         self.incarnation = os.urandom(8).hex()
-        # cephx ticket auth (optional, composes with/replaces the
-        # static PSK): a CLIENT sets `ticket` ({"gen", "ticket",
-        # "session_key"}) and proves the session key; a SERVER sets
-        # `ticket_validator(gen, blob) -> session_key bytes` (raises
-        # to reject).  The ticket's session key becomes the
-        # connection secret for negotiation MAC + secure-mode keys,
-        # so a leaked PSK stops being forever (round-3 review).
-        self.ticket: dict | None = None
+        # cephx ticket auth (composes with/replaces the static PSK,
+        # src/auth/cephx/CephxProtocol.h): a CLIENT stores tickets per
+        # target service in `tickets` ({"gen", "ticket", "session_key"
+        # hex, "expires"}); connect() picks by the peer name's prefix
+        # ("osd.3" -> tickets["osd"]) and proves the session key in
+        # the handshake instead of the PSK.  A SERVER sets
+        # `ticket_validator(gen, blob_hex) -> session_key bytes`
+        # (raises to reject); the validated session key becomes the
+        # connection secret for the proof, negotiation MAC, and
+        # secure-mode AEAD keys, so a leaked PSK stops being forever
+        # (round-3 review).  `require_ticket` makes the server NACK
+        # peers that present no (or a bad) ticket.
+        self.tickets: dict[str, dict] = {}
         self.ticket_validator = None
+        self.require_ticket = False
         self.dispatchers: list[Dispatcher] = []
         # one connection per peer per DIRECTION: simultaneous cross-
         # connects between two daemons are legal and never race over a
@@ -273,7 +283,7 @@ class Messenger:
             writer.close()
             return
         try:
-            peer_name, inst, nego, hs_nonce, hs_cnonce = \
+            peer_name, inst, nego, hs_nonce, hs_cnonce, hs_secret = \
                 await self._handshake_server_read(reader, writer)
         except (asyncio.IncompleteReadError, ValueError, ConnectionError):
             writer.close()
@@ -302,12 +312,22 @@ class Messenger:
             return
         conn = Connection(self, peer_name, reader, writer, outgoing=False)
         self._apply_negotiation(conn, nego, hs_nonce, hs_cnonce,
-                                is_server=True)
+                                is_server=True, secret=hs_secret)
         conn.in_seq = last_seq
         self.conns_in[peer_name] = conn
         conn._read_task = asyncio.ensure_future(self._read_loop(conn))
 
     # -- handshake (HMAC challenge, cephx-lite) ------------------------------
+    def _ticket_for(self, peer_name: str) -> dict | None:
+        """The live ticket for the peer's service class, if any
+        (expired tickets are dropped -- the owner refreshes)."""
+        service = peer_name.split(".", 1)[0]
+        t = self.tickets.get(service)
+        if t is not None and t.get("expires", 0) < time.time():
+            del self.tickets[service]
+            return None
+        return t
+
     def _session_keys(self, nonce: bytes, cnonce: bytes, salt: bytes,
                       secret: bytes | None = None):
         """Per-direction session keys from the full transcript: server
@@ -329,61 +349,95 @@ class Messenger:
                   cnonce: bytes, secret: bytes | None = None) -> str:
         """Bind the negotiation to the shared secret: a MITM rewriting
         the plaintext nego blob (encryption downgrade) fails the MAC."""
-        if self.secret is None:
+        secret = secret if secret is not None else self.secret
+        if secret is None:
             return ""
         blob = json.dumps({k: nego[k] for k in
                            ("compression", "secure", "salt")},
                           sort_keys=True).encode()
-        secret = secret if secret is not None else self.secret
         return hmac.new(secret, b"nego" + nonce + cnonce + blob,
                         hashlib.sha256).hexdigest()
 
-    def _negotiate(self, offered: dict) -> dict:
+    def _negotiate(self, offered: dict,
+                   secret: bytes | None = None) -> dict:
         """Server side: pick the on-wire transforms."""
         comp = ""
         if self.compression and self.compression in offered.get(
                 "compress", []):
             comp = self.compression
         secure = bool(offered.get("secure")) and self.secure \
-            and self.secret is not None
+            and (secret if secret is not None
+                 else self.secret) is not None
         return {"compression": comp, "secure": secure,
                 "salt": os.urandom(16).hex()}
 
     async def _handshake_server_read(self, reader, writer):
         """Server side up to (not including) the ACK: returns
-        (peer name, peer incarnation, negotiated transforms, nonce)."""
+        (peer name, peer incarnation, negotiated transforms, nonce,
+        cnonce, connection secret)."""
         nonce = os.urandom(16)
-        writer.write(HELLO_MAGIC + struct.pack("<16s", nonce))
+        # hello flags advertise ticket support so a ticket-holding
+        # client talking to a PSK-only server falls back to the PSK
+        # instead of proving a key the server can't derive
+        flags = (HELLO_ACCEPTS_TICKETS
+                 if self.ticket_validator is not None else 0) \
+            | (HELLO_REQUIRES_TICKET if self.require_ticket else 0)
+        writer.write(HELLO_MAGIC + struct.pack("<16sB", nonce, flags))
         await writer.drain()
         hdr = await reader.readexactly(4)
         if hdr != HELLO_MAGIC:
             raise ValueError("bad hello")
         (nlen,) = struct.unpack("<I", await reader.readexactly(4))
         payload = json.loads(await reader.readexactly(nlen))
+
+        async def reject(why: str):
+            writer.write(b"NACK")
+            await writer.drain()
+            raise ValueError(why)
+
+        # cephx: a presented ticket, once validated against the
+        # rotating service keys, carries the session key that becomes
+        # THIS connection's secret (proof, nego MAC, AEAD) -- and its
+        # sealed entity must MATCH the claimed peer name, or any
+        # service-class ticket holder could impersonate any daemon
+        secret = self.secret
+        cephx = payload.get("cephx")
+        if cephx is not None and self.ticket_validator is not None:
+            try:
+                info = self.ticket_validator(cephx["gen"],
+                                             cephx["ticket"])
+            except Exception as e:
+                await reject(f"cephx ticket rejected: {e}")
+            if info["entity"] != payload.get("name"):
+                await reject(
+                    f"ticket entity {info['entity']!r} does not match "
+                    f"claimed name {payload.get('name')!r}")
+            secret = info["session_key"]
+        elif self.require_ticket:
+            await reject("cephx ticket required")
+
         proof = bytes.fromhex(payload.get("proof", ""))
-        if self.secret is not None:
-            want = hmac.new(self.secret, nonce, hashlib.sha256).digest()
+        if secret is not None:
+            want = hmac.new(secret, nonce, hashlib.sha256).digest()
             if not hmac.compare_digest(proof, want):
-                writer.write(b"NACK")
-                await writer.drain()
-                raise ValueError("auth failure")
-        nego = self._negotiate(payload)
+                await reject("auth failure")
+        nego = self._negotiate(payload, secret)
         if self.secure and not nego["secure"]:
             # the server's secure requirement binds BOTH directions: a
             # peer that won't (or can't) encrypt gets no session at all
-            writer.write(b"NACK")
-            await writer.drain()
-            raise ValueError("peer did not offer secure mode")
+            await reject("peer did not offer secure mode")
         cnonce = bytes.fromhex(payload.get("cnonce", "")) or b"\0" * 16
-        nego["mac"] = self._nego_mac(nego, nonce, cnonce)
+        nego["mac"] = self._nego_mac(nego, nonce, cnonce, secret)
         return payload["name"], payload.get("inst", ""), nego, \
-            nonce, cnonce
+            nonce, cnonce, secret
 
     def _apply_negotiation(self, conn: Connection, nego: dict,
                            nonce: bytes, cnonce: bytes,
-                           is_server: bool) -> None:
+                           is_server: bool,
+                           secret: bytes | None = None) -> None:
         if conn.outgoing is is_server:
             raise ValueError("negotiation direction mismatch")
+        secret = secret if secret is not None else self.secret
         # a RE-negotiation (reconnect) replaces the transforms wholesale:
         # keeping a stale compressor after the peer stopped offering it
         # would emit frames the peer can no longer parse
@@ -393,7 +447,7 @@ class Messenger:
         if not is_server:
             # client: verify the server's pick against the transcript
             # MAC and refuse a downgrade of our secure requirement
-            want = self._nego_mac(nego, nonce, cnonce)
+            want = self._nego_mac(nego, nonce, cnonce, secret)
             if want and not hmac.compare_digest(
                     want, nego.get("mac", "")):
                 raise ValueError("negotiation MAC mismatch (tampered?)")
@@ -410,26 +464,43 @@ class Messenger:
                 raise ValueError(str(e)) from e
         if nego.get("secure"):
             c2s, s2c = self._session_keys(nonce, cnonce,
-                                          bytes.fromhex(nego["salt"]))
+                                          bytes.fromhex(nego["salt"]),
+                                          secret)
             if is_server:
                 conn.aead_rx, conn.aead_tx = c2s, s2c
             else:
                 conn.aead_tx, conn.aead_rx = c2s, s2c
 
-    async def _handshake_client(self, reader, writer):
-        hdr = await reader.readexactly(20)
+    async def _handshake_client(self, reader, writer,
+                                peer_name: str = ""):
+        hdr = await reader.readexactly(21)
         if hdr[:4] != HELLO_MAGIC:
             raise ValueError("bad hello")
         nonce = hdr[4:20]
+        flags = hdr[20]
+        # a live ticket for the peer's service replaces the PSK: we
+        # prove the ticket's session key, and the server recovers the
+        # same key from the sealed ticket blob.  Only presented when
+        # the server's hello says it can validate tickets (a PSK-only
+        # server would otherwise fail our proof)
+        secret = self.secret
+        fields = {}
+        ticket = (self._ticket_for(peer_name)
+                  if peer_name and flags & HELLO_ACCEPTS_TICKETS
+                  else None)
+        if ticket is not None:
+            secret = bytes.fromhex(ticket["session_key"])
+            fields["cephx"] = {"gen": ticket["gen"],
+                               "ticket": ticket["ticket"]}
         proof = b""
-        if self.secret is not None:
-            proof = hmac.new(self.secret, nonce, hashlib.sha256).digest()
+        if secret is not None:
+            proof = hmac.new(secret, nonce, hashlib.sha256).digest()
         cnonce = os.urandom(16)
         payload = json.dumps({
             "name": self.name, "inst": self.incarnation,
             "proof": proof.hex(), "cnonce": cnonce.hex(),
             "compress": [self.compression] if self.compression else [],
-            "secure": self.secure}).encode()
+            "secure": self.secure, **fields}).encode()
         writer.write(HELLO_MAGIC + struct.pack("<I", len(payload)) + payload)
         await writer.drain()
         ack = await reader.readexactly(4)
@@ -438,7 +509,7 @@ class Messenger:
         (last_seq,) = struct.unpack("<Q", await reader.readexactly(8))
         (nego_len,) = struct.unpack("<I", await reader.readexactly(4))
         nego = json.loads(await reader.readexactly(nego_len))
-        return last_seq, nego, nonce, cnonce
+        return last_seq, nego, nonce, cnonce, secret
 
     # -- client -------------------------------------------------------------
     async def connect(self, addr: tuple[str, int],
@@ -464,12 +535,12 @@ class Messenger:
                 replay = [m for m, _ in conn.unacked]
             reader, writer = await asyncio.open_connection(
                 addr[0], addr[1])
-            last_seq, nego, hs_nonce, hs_cnonce = \
-                await self._handshake_client(reader, writer)
+            last_seq, nego, hs_nonce, hs_cnonce, hs_secret = \
+                await self._handshake_client(reader, writer, peer_name)
             conn = Connection(self, peer_name, reader, writer,
                               outgoing=True, peer_addr=addr)
             self._apply_negotiation(conn, nego, hs_nonce, hs_cnonce,
-                                    is_server=False)
+                                    is_server=False, secret=hs_secret)
             # continue the server's seq space: a same-incarnation
             # session survives connection churn, and starting below
             # last_seq would get every message deduped as a replay
@@ -502,8 +573,9 @@ class Messenger:
                 try:
                     reader, writer = await asyncio.open_connection(
                         conn.peer_addr[0], conn.peer_addr[1])
-                    last_seq, nego, hs_nonce, hs_cnonce = \
-                        await self._handshake_client(reader, writer)
+                    last_seq, nego, hs_nonce, hs_cnonce, hs_secret = \
+                        await self._handshake_client(reader, writer,
+                                                     conn.peer_name)
                     # swap + replay under the SEND lock: a sender mid-
                     # flight must not write a newer seq onto the fresh
                     # stream before the replay of older unacked frames
@@ -512,7 +584,8 @@ class Messenger:
                     async with conn._send_lock:
                         self._apply_negotiation(conn, nego, hs_nonce,
                                                 hs_cnonce,
-                                                is_server=False)
+                                                is_server=False,
+                                                secret=hs_secret)
                         conn._trim_acked(last_seq)
                         conn.reader, conn.writer = reader, writer
                         # server->client stream restarts on new accept

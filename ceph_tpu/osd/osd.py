@@ -29,8 +29,18 @@ class OSD:
                  secret: bytes | None = None,
                  config: dict | None = None,
                  admin_socket_path: str | None = None,
-                 msgr_opts: dict | None = None) -> None:
+                 msgr_opts: dict | None = None,
+                 cephx_key: str | None = None,
+                 require_ticket: bool = False) -> None:
         self.msgr_opts = msgr_opts
+        # cephx: this OSD's entity key (hex).  When set, boot fetches
+        # the rotating "osd" service keys (to VALIDATE tickets peers
+        # present) and its own ticket (to PRESENT on osd->osd
+        # connections); require_ticket makes the messenger NACK
+        # ticketless peers (src/auth/cephx/CephxProtocol.h)
+        self.cephx_key = cephx_key
+        self.require_ticket = require_ticket
+        self._rk_holder: dict | None = None
         self.host = host
         self.store = store or make_default_store()
         # identity lives in the store (OSD superblock analog,
@@ -162,6 +172,8 @@ class OSD:
         self.monmap = [list(a) for a in ack.get("monmap", [])] or \
             [list(self.mon_addr)]
         self.msgr.name = f"osd.{self.whoami}"
+        if self.cephx_key:
+            await self._cephx_boot()
         # subscribe to map deltas; mon replies with the full map
         full = await self._mon_request("sub_osdmap", {},
                                        reply_type="osdmap_full")
@@ -585,6 +597,45 @@ class OSD:
         except asyncio.CancelledError:
             pass
 
+    # -- cephx ---------------------------------------------------------------
+    async def _cephx_boot(self) -> None:
+        """Fetch rotating validation keys + our own service ticket
+        over the (PSK-authenticated) mon session, install the
+        messenger validator (src/auth/RotatingKeyRing.h role)."""
+        from ..common.cephx import (fetch_rotating, fetch_ticket,
+                                    install_validator)
+        entity = f"osd.{self.whoami}"
+        rk = await fetch_rotating(self.msgr, self.mon_addr, entity,
+                                  self.cephx_key, "osd")
+        self._rk_holder = {"rk": rk}
+        install_validator(self.msgr, self._rk_holder)
+        self.msgr.require_ticket = self.require_ticket
+        await fetch_ticket(self.msgr, self.mon_addr, entity,
+                           self.cephx_key, "osd")
+        self._cephx_next_refresh = time.monotonic() + 60.0
+
+    async def _cephx_refresh(self) -> None:
+        """Keep validation keys current across rotations and our own
+        ticket live past its expiry; runs on the heartbeat cadence."""
+        if not self.cephx_key or self._rk_holder is None:
+            return
+        now = time.monotonic()
+        if now < getattr(self, "_cephx_next_refresh", 0):
+            return
+        self._cephx_next_refresh = now + 60.0
+        from ..common.cephx import fetch_rotating, fetch_ticket
+        entity = f"osd.{self.whoami}"
+        try:
+            t = self.msgr.tickets.get("osd")
+            if t is None or t["expires"] - time.time() < 120.0:
+                await fetch_ticket(self.msgr, self.mon_addr, entity,
+                                   self.cephx_key, "osd")
+            self._rk_holder["rk"] = await fetch_rotating(
+                self.msgr, self.mon_addr, entity,
+                self.cephx_key, "osd")
+        except Exception:
+            pass            # mon hunt/retry next cycle
+
     async def _ping_one(self, osd: int, now: float) -> None:
         """One bounded ping send — a dead peer's connect/reconnect stall
         must never block the heartbeat cycle (the reference runs a
@@ -607,6 +658,7 @@ class OSD:
         if now - getattr(self, "_last_map_time", now) > 5.0:
             self._last_map_time = now          # one probe per window
             self._track(asyncio.ensure_future(self._catch_up_maps()))
+        await self._cephx_refresh()
         # mgr perf reporting rides the same cadence (MgrClient reports)
         if now - getattr(self, "_last_mgr_report", 0.0) > 2.0:
             self._last_mgr_report = now

@@ -1,0 +1,349 @@
+"""cephx end-to-end: ticket issue/validate over the real messenger,
+expiry, service-key rotation aging out stolen keys, forged-ticket and
+ticketless rejection, and peon->leader forwarding of auth traffic.
+
+Role analog: src/auth/cephx/CephxProtocol.h (ticket build/verify),
+src/auth/RotatingKeyRing.h (two live generations), MAuth round trip.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.common.cephx import (CephxAuthority, CephxError,
+                                   RotatingKeys, fetch_rotating,
+                                   fetch_ticket, install_validator,
+                                   seal, unseal, validate_ticket)
+from ceph_tpu.mon import Monitor
+from ceph_tpu.msg import Message, Messenger
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# -- unit: protocol math ------------------------------------------------------
+
+def test_ticket_roundtrip_and_expiry():
+    auth = CephxAuthority(ticket_ttl=600)
+    pkg = auth.issue_ticket("client.a", "ab" * 16, "osd", now=1000.0)
+    rk = auth.rotating["osd"]
+    info = validate_ticket(rk, pkg["gen"], pkg["ticket"], now=1100.0)
+    assert info["entity"] == "client.a"
+    # the client-side copy of the session key matches the ticket's
+    sess = unseal(bytes.fromhex("ab" * 16), pkg["session"])
+    assert sess["session_key"] == info["session_key"]
+    with pytest.raises(CephxError, match="expired"):
+        validate_ticket(rk, pkg["gen"], pkg["ticket"], now=1601.0)
+
+
+def test_rotation_invalidates_old_keys_in_two_generations():
+    rk = RotatingKeys(ttl=100)
+    auth = CephxAuthority()
+    auth.rotating["osd"] = rk
+    pkg = auth.issue_ticket("client.a", "cd" * 16, "osd", now=0.0)
+    gen0 = pkg["gen"]
+    rk._rotate(100.0)          # gen0 still valid (previous generation)
+    validate_ticket(rk, gen0, pkg["ticket"], now=10.0)
+    rk._rotate(200.0)          # two rotations: gen0 retired
+    with pytest.raises(CephxError, match="retired"):
+        validate_ticket(rk, gen0, pkg["ticket"], now=10.0)
+
+
+def test_forged_ticket_rejected():
+    auth = CephxAuthority()
+    pkg = auth.issue_ticket("client.a", "ef" * 16, "osd")
+    rk = auth.rotating["osd"]
+    other = RotatingKeys()      # an attacker's own keys
+    with pytest.raises(CephxError):
+        validate_ticket(other, pkg["gen"], pkg["ticket"])
+    # bit-flipped blob fails AEAD open
+    bad = bytearray(bytes.fromhex(pkg["ticket"]))
+    bad[20] ^= 0xFF
+    with pytest.raises(CephxError, match="unseal"):
+        validate_ticket(rk, pkg["gen"], bad.hex())
+
+
+# -- messenger: ticket handshake ---------------------------------------------
+
+def _authority_pair():
+    """An issuing authority plus a server messenger validating with
+    the live rotating keys."""
+    auth = CephxAuthority(ticket_ttl=600)
+    rk = auth.service_keys("osd")
+    server = Messenger("osd.0")
+    install_validator(server, {"rk": rk})
+    return auth, server
+
+
+async def _echo_server(server):
+    got = asyncio.Queue()
+
+    async def d(conn, msg):
+        if msg.type == "echo":
+            await got.put(msg.data)
+            await conn.send(Message("echo_reply", msg.data))
+    server.add_dispatcher(d)
+    addr = await server.bind()
+    return addr, got
+
+
+def _client_with_ticket(auth, entity="client.t", key_hex="11" * 16):
+    pkg = auth.issue_ticket(entity, key_hex, "osd")
+    sess = unseal(bytes.fromhex(key_hex), pkg["session"])
+    msgr = Messenger(entity)
+    msgr.tickets["osd"] = {"gen": pkg["gen"], "ticket": pkg["ticket"],
+                           "session_key": sess["session_key"],
+                           "expires": sess["expires"]}
+    return msgr
+
+
+def test_messenger_ticket_handshake_happy_path():
+    async def main():
+        auth, server = _authority_pair()
+        server.require_ticket = True
+        addr, got = await _echo_server(server)
+        client = _client_with_ticket(auth)
+        await client.send(addr, "osd.0", Message("echo", {"x": 1}))
+        assert (await asyncio.wait_for(got.get(), 5))["x"] == 1
+        await client.shutdown()
+        await server.shutdown()
+    run(main())
+
+
+def test_messenger_rejects_ticketless_and_forged():
+    async def main():
+        auth, server = _authority_pair()
+        server.require_ticket = True
+        addr, _ = await _echo_server(server)
+        # no ticket at all
+        bare = Messenger("client.bare")
+        with pytest.raises((ConnectionError, OSError)):
+            await bare.send(addr, "osd.0", Message("echo", {}))
+        # forged ticket: sealed under the wrong service key
+        rogue = CephxAuthority()
+        rogue.service_keys("osd")
+        forged = _client_with_ticket(rogue, "client.forged")
+        with pytest.raises((ConnectionError, OSError)):
+            await forged.send(addr, "osd.0", Message("echo", {}))
+        # expired ticket is dropped client-side -> treated as absent
+        stale = _client_with_ticket(auth, "client.stale")
+        stale.tickets["osd"]["expires"] = time.time() - 1
+        with pytest.raises((ConnectionError, OSError)):
+            await stale.send(addr, "osd.0", Message("echo", {}))
+        for m in (bare, forged, stale):
+            await m.shutdown()
+        await server.shutdown()
+    run(main())
+
+
+def test_messenger_ticket_session_key_drives_secure_mode():
+    """With no PSK anywhere, the ticket's session key alone must carry
+    AES-GCM secure mode."""
+    async def main():
+        auth = CephxAuthority(ticket_ttl=600)
+        rk = auth.service_keys("osd")
+        server = Messenger("osd.0", secure=True)
+        install_validator(server, {"rk": rk})
+        server.require_ticket = True
+        addr, got = await _echo_server(server)
+        client = _client_with_ticket(auth)
+        client.secure = True          # offer secure; key from ticket
+        await client.send(addr, "osd.0", Message("echo", {"s": 2}))
+        assert (await asyncio.wait_for(got.get(), 5))["s"] == 2
+        conn = client.conns["osd.0"]
+        assert conn.aead_tx is not None     # encryption actually on
+        await client.shutdown()
+        await server.shutdown()
+    run(main())
+
+
+# -- mon integration ----------------------------------------------------------
+
+async def _mk_auth_entity(mon_addr, entity):
+    """auth get-or-create via mon_command; returns the entity key."""
+    msgr = Messenger("client.setup")
+    q = asyncio.Queue()
+
+    async def d(conn, msg):
+        if msg.type == "mon_command_reply":
+            await q.put(msg.data)
+
+    msgr.add_dispatcher(d)
+    await msgr.send(mon_addr, "mon.0",
+                    Message("mon_command",
+                            {"cmd": "auth get-or-create",
+                             "args": {"entity": entity}}))
+    data = await asyncio.wait_for(q.get(), 5)
+    await msgr.shutdown()
+    assert data["ok"], data
+    return data["result"]["key"]
+
+
+def test_mon_issues_ticket_and_osd_validates_over_messenger():
+    """The full loop: entity registered at the mon, daemon fetches
+    rotating keys, client fetches a ticket, and the client->daemon
+    connection authenticates with it over the real messenger."""
+    async def main():
+        mon = Monitor()
+        addr = await mon.start()
+        ckey = await _mk_auth_entity(addr, "client.app")
+        okey = await _mk_auth_entity(addr, "osd.7")
+
+        # daemon side: rotating keys for its service class
+        daemon = Messenger("osd.7")
+        rk = await fetch_rotating(daemon, addr, "osd.7", okey, "osd")
+        install_validator(daemon, {"rk": rk})
+        daemon.require_ticket = True
+        got = asyncio.Queue()
+
+        async def d(conn, msg):
+            if msg.type == "echo":
+                await got.put(msg.data)
+        daemon.add_dispatcher(d)
+        osd_addr = await daemon.bind()
+
+        # client side: ticket via the mon, then talk to the daemon
+        client = Messenger("client.app")
+        await fetch_ticket(client, addr, "client.app", ckey, "osd")
+        await client.send(osd_addr, "osd.7",
+                          Message("echo", {"hello": True}))
+        assert (await asyncio.wait_for(got.get(), 5))["hello"]
+
+        # wrong entity key cannot obtain a ticket
+        thief = Messenger("client.thief")
+        with pytest.raises(CephxError, match="proof mismatch"):
+            await fetch_ticket(thief, addr, "client.app", "00" * 16,
+                               "osd")
+        for m in (daemon, client, thief):
+            await m.shutdown()
+        await mon.stop()
+    run(main())
+
+
+def test_peon_forwards_auth_to_leader():
+    """auth_get_ticket sent to a PEON must come back with a ticket the
+    replicated service keys validate (the peon may not mint keys)."""
+    async def main():
+        mons = [Monitor(rank=r, peers=[None] * 3,
+                        config={"mon_lease": 1.0})
+                for r in range(3)]
+        addrs = [await m.start() for m in mons]
+        for m in mons:
+            m.peer_addrs = list(addrs)
+        for _ in range(100):
+            if any(m.is_leader for m in mons):
+                break
+            await asyncio.sleep(0.1)
+        leader = next(m for m in mons if m.is_leader)
+        peon = next(m for m in mons if not m.is_leader)
+        ckey = await _mk_auth_entity(
+            tuple(peon.msgr.addr), "client.via-peon")
+
+        client = Messenger("client.via-peon")
+        t = await fetch_ticket(client, tuple(peon.msgr.addr),
+                               "client.via-peon", ckey, "osd")
+        # the ticket must validate against the LEADER's keys (the only
+        # ones that get persisted/replicated)
+        info = validate_ticket(leader.cephx.rotating["osd"],
+                               t["gen"], t["ticket"])
+        assert info["entity"] == "client.via-peon"
+        # and against the peon's replicated copy once paxos catches up
+        await asyncio.sleep(0.3)
+        peon_rk = peon.cephx.rotating.get("osd")
+        assert peon_rk is not None, "rotating keys not replicated"
+        assert validate_ticket(peon_rk, t["gen"],
+                               t["ticket"])["entity"] \
+            == "client.via-peon"
+        await client.shutdown()
+        for m in mons:
+            await m.stop()
+    run(main())
+
+
+def test_osd_cluster_with_cephx_required():
+    """A real OSD booted with cephx enforcing tickets: an
+    authenticated Rados client does I/O; a ticketless client's ops
+    never reach the OSD."""
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.osd import OSD
+
+    async def main():
+        mon = Monitor(rank=0,
+                      config={"mon_osd_min_down_reporters": 1})
+        addr = await mon.start()
+        mon.peer_addrs = [addr]
+        # pre-register the OSD entities (vstart would do this)
+        okeys = [await _mk_auth_entity(addr, f"osd.{i}")
+                 for i in range(2)]
+        osds = []
+        for i in range(2):
+            osd = OSD(host=f"host{i}", whoami=i, cephx_key=okeys[i],
+                      require_ticket=True)
+            await osd.start(addr)
+            osds.append(osd)
+        ckey = await _mk_auth_entity(addr, "client.app")
+
+        r = Rados(addr, name="client.app")
+        await r.connect()
+        await r.authenticate("client.app", ckey)
+        await r.mon_command("osd pool create",
+                            {"name": "p", "pg_num": 4, "size": 2})
+        ioctx = await r.open_ioctx("p")
+        await ioctx.write_full("obj", b"ticketed payload")
+        assert await ioctx.read("obj") == b"ticketed payload"
+
+        # a client that skipped authenticate() cannot reach the OSDs
+        bare = Rados(addr, name="client.bare")
+        await bare.connect()
+        bare_ioctx = await bare.open_ioctx("p")
+        with pytest.raises(Exception):
+            await asyncio.wait_for(
+                bare_ioctx.write_full("obj2", b"x"), 6)
+
+        await r.shutdown()
+        await bare.shutdown()
+        for o in osds:
+            await o.stop()
+        await mon.stop()
+    run(main())
+
+
+def test_ticket_entity_must_match_claimed_name():
+    """A valid 'osd'-service ticket for client.t must NOT let its
+    holder connect claiming to be an OSD (impersonation)."""
+    async def main():
+        auth, server = _authority_pair()
+        server.require_ticket = True
+        addr, _ = await _echo_server(server)
+        imp = _client_with_ticket(auth, entity="client.t")
+        imp.name = "osd.3"            # lie about who we are
+        with pytest.raises((ConnectionError, OSError)):
+            await imp.send(addr, "osd.0", Message("echo", {}))
+        await imp.shutdown()
+        await server.shutdown()
+    run(main())
+
+
+def test_ticket_client_falls_back_to_psk_server():
+    """A ticket-holding client connecting to a PSK-only server (no
+    validator installed) must fall back to the PSK, not prove a
+    session key the server can't derive."""
+    async def main():
+        psk = b"cluster-psk"
+        auth = CephxAuthority()
+        auth.service_keys("osd")
+        server = Messenger("osd.9", secret=psk)
+        addr, got = await _echo_server(server)
+        client = _client_with_ticket(auth, entity="client.mixed")
+        client.secret = psk
+        await client.send(addr, "osd.9", Message("echo", {"ok": 1}))
+        assert (await asyncio.wait_for(got.get(), 5))["ok"] == 1
+        await client.shutdown()
+        await server.shutdown()
+    run(main())

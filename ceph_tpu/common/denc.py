@@ -24,7 +24,24 @@ from __future__ import annotations
 import struct
 
 
-class DencError(Exception):
+_FAST = None
+_FAST_TRIED = False
+
+
+def _fast():
+    """The C value codec (byte-identical; see native/denc_value.cc)."""
+    global _FAST, _FAST_TRIED
+    if not _FAST_TRIED:
+        _FAST_TRIED = True
+        from ..native import get_dencfast
+        _FAST = get_dencfast()
+    return _FAST
+
+
+class DencError(ValueError):
+    # subclasses ValueError: the messenger read loops treat any
+    # ValueError as a framing error (close/reconnect), and a malformed
+    # denc envelope must take that path exactly as bad JSON used to
     pass
 
 
@@ -99,6 +116,74 @@ class Encoder:
             fn(self, v)
         return self
 
+    # -- generic tagged value (JSON data model, binary bytes) ---------------
+    def value(self, v) -> "Encoder":
+        """Tagged encoding of an arbitrary JSON-shaped value: the wire
+        meta's replacement for json.dumps.  Deliberately mirrors
+        JSON's semantics so the switch is invisible to message
+        handlers: dict keys coerce to strings, tuples become lists.
+        Raises DencError on types JSON could not carry either.
+
+        The hot path runs in C (native/denc_value.cc, byte-identical
+        format); this Python body is the reference implementation and
+        the fallback for exact-type mismatches (e.g. int subclasses)
+        and toolchain-less environments."""
+        fast = _fast()
+        if fast is not None:
+            try:
+                self.buf += fast.encode_value(v)
+                return self
+            except TypeError:
+                pass        # subclass or foreign type: reference path
+            except ValueError as e:
+                raise DencError(str(e)) from e   # e.g. depth limit
+        return self._value_py(v)
+
+    def _value_py(self, v, depth: int = 0) -> "Encoder":
+        if depth > 200:
+            # same cap as the C codec: hosts with and without the
+            # toolchain must agree on what is encodable
+            raise DencError("value nesting too deep")
+        if v is None:
+            self.u8(0)
+        elif v is True:
+            self.u8(1)
+        elif v is False:
+            self.u8(2)
+        elif isinstance(v, int):
+            if -(1 << 63) <= v < (1 << 63):
+                self.u8(3)
+                self.i64(v)
+            else:                        # python bignum: decimal text
+                self.u8(9)
+                self.string(str(v))
+        elif isinstance(v, float):
+            self.u8(4)
+            self.f64(v)
+        elif isinstance(v, str):
+            self.u8(5)
+            self.string(v)
+        elif isinstance(v, (bytes, bytearray, memoryview)):
+            self.u8(6)
+            self.blob(bytes(v))
+        elif isinstance(v, (list, tuple)):
+            self.u8(7)
+            self.u32(len(v))
+            for it in v:
+                self._value_py(it, depth + 1)
+        elif isinstance(v, dict):
+            self.u8(8)
+            self.u32(len(v))
+            for k, vv in v.items():      # insertion order, like JSON
+                if not isinstance(k, str):
+                    k = str(k)           # json.dumps key coercion
+                self.string(k)
+                self._value_py(vv, depth + 1)
+        else:
+            raise DencError(
+                f"unencodable value type {type(v).__name__}")
+        return self
+
     # -- versioned envelope --------------------------------------------------
     def start(self, v: int, compat: int) -> "Encoder":
         """ENCODE_START: version byte, compat byte, length placeholder."""
@@ -170,6 +255,52 @@ class Decoder:
 
     def optional(self, fn):
         return fn(self) if self.boolean() else None
+
+    # -- generic tagged value ------------------------------------------------
+    def value(self):
+        fast = _fast()
+        if fast is not None:
+            end = self._ends[-1] if self._ends else len(self.data)
+            try:
+                obj, pos = fast.decode_value(self.data, self.pos)
+            except ValueError as e:
+                raise DencError(str(e)) from e
+            if pos > end:
+                raise DencError("value ran past envelope end")
+            self.pos = pos
+            return obj
+        return self._value_py()
+
+    def _value_py(self, depth: int = 0):
+        if depth > 200:
+            # parity with the C codec, and a RecursionError from a
+            # hostile deep payload would not be a ValueError (the
+            # framing-error class the read loop handles)
+            raise DencError("value nesting too deep")
+        tag = self.u8()
+        if tag == 0:
+            return None
+        if tag == 1:
+            return True
+        if tag == 2:
+            return False
+        if tag == 3:
+            return self.i64()
+        if tag == 4:
+            return self.f64()
+        if tag == 5:
+            return self.string()
+        if tag == 6:
+            return self.blob()
+        if tag == 7:
+            return [self._value_py(depth + 1)
+                    for _ in range(self.u32())]
+        if tag == 8:
+            return {self.string(): self._value_py(depth + 1)
+                    for _ in range(self.u32())}
+        if tag == 9:
+            return int(self.string())
+        raise DencError(f"bad value tag {tag}")
 
     # -- versioned envelope --------------------------------------------------
     def start(self, supported: int) -> int:

@@ -1,10 +1,19 @@
 """Wire message model and v2-lite frame codec.
 
-Frame = magic | u32 meta_len | meta(json) | segments | u32 crc32c, where
-meta carries {t, seq, from, data, seg_lens}.  JSON meta + raw binary
-segments keeps control fields debuggable while bulk chunk bytes stay
-zero-copy -- the same meta/payload segment split ProtocolV2 frames use
-(4 segments + epilogue crcs, src/msg/async/frames_v2.cc).
+Frame = magic | u32 meta_len | meta(denc) | segments | u32 crc32c.
+The meta envelope is the repo's own versioned denc encoding
+(common/denc.py), NOT json: hot-path types (osd_op, rep_op, ping --
+msg/wire_types.py) get explicit MOSDOp-style field layouts, everything
+else rides the generic tagged-value encoding, and a json escape hatch
+remains only for payloads denc cannot express.  Raw binary segments
+stay zero-copy -- the same meta/payload segment split ProtocolV2
+frames use (4 segments + epilogue crcs, src/msg/async/frames_v2.cc).
+
+meta envelope (denc, struct_v 1):
+  string t | u64 seq | string from | u8 kind | blob payload |
+  list<u32> seg_lens
+where kind selects the payload codec: 0 generic value, 1 json
+(escape hatch), 2 typed (wire_types.WIRE_CODECS[t]).
 """
 
 from __future__ import annotations
@@ -14,10 +23,15 @@ import struct
 from dataclasses import dataclass, field
 from typing import Any
 
+from ..common.denc import Decoder, DencError, Encoder
 from ..native import crc32c
 
-MAGIC = b"CTv2"
+MAGIC = b"CTv3"
 MAX_FRAME = 256 << 20
+
+KIND_VALUE = 0
+KIND_JSON = 1
+KIND_TYPED = 2
 
 
 @dataclass
@@ -29,14 +43,38 @@ class Message:
     from_name: str = ""
 
     def encode(self) -> bytes:
-        meta = {
-            "t": self.type,
-            "seq": self.seq,
-            "from": self.from_name,
-            "data": self.data,
-            "segs": [len(s) for s in self.segments],
-        }
-        mb = json.dumps(meta, separators=(",", ":")).encode()
+        from .wire_types import WIRE_CODECS
+        payload = Encoder()
+        codec = WIRE_CODECS.get(self.type)
+        try:
+            if codec is not None:
+                kind = KIND_TYPED
+                codec[0](payload, self.data)
+            else:
+                kind = KIND_VALUE
+                payload.value(self.data)
+        except (DencError, TypeError, OverflowError) as denc_err:
+            # escape hatch: a payload the denc codecs (typed OR
+            # generic) cannot express falls back to json -- best
+            # effort, since json's data model is a subset; if json
+            # can't carry it either, the original error surfaces
+            try:
+                blob = json.dumps(self.data).encode()
+            except (TypeError, ValueError):
+                raise denc_err
+            kind = KIND_JSON
+            payload = Encoder()
+            payload.blob(blob)
+        enc = Encoder()
+        enc.start(1, 1)
+        enc.string(self.type)
+        enc.u64(self.seq)
+        enc.string(self.from_name)
+        enc.u8(kind)
+        enc.blob(payload.bytes())
+        enc.list([len(s) for s in self.segments], Encoder.u32)
+        enc.finish()
+        mb = enc.bytes()
         body = mb + b"".join(self.segments)
         crc = crc32c(body) & 0xFFFFFFFF
         return MAGIC + struct.pack("<I", len(mb)) + body + struct.pack(
@@ -48,18 +86,43 @@ class Message:
             raise ValueError("bad magic")
         (meta_len,) = struct.unpack_from("<I", buf, 4)
         mb = buf[8:8 + meta_len]
-        meta = json.loads(mb)
         (crc,) = struct.unpack_from("<I", buf, len(buf) - 4)
         body = buf[8:len(buf) - 4]
         if (crc32c(body) & 0xFFFFFFFF) != crc:
             raise ValueError("frame crc mismatch")
+        mtype, seq, from_name, data, seg_lens = _decode_meta(mb)
         segments = []
         off = 8 + meta_len
-        for ln in meta["segs"]:
+        for ln in seg_lens:
             segments.append(buf[off:off + ln])
             off += ln
-        return cls(type=meta["t"], data=meta["data"], segments=segments,
-                   seq=meta["seq"], from_name=meta["from"])
+        return cls(type=mtype, data=data, segments=segments,
+                   seq=seq, from_name=from_name)
+
+
+def _decode_meta(mb) -> tuple:
+    from .wire_types import WIRE_CODECS
+    dec = Decoder(mb)
+    dec.start(1)
+    mtype = dec.string()
+    seq = dec.u64()
+    from_name = dec.string()
+    kind = dec.u8()
+    payload = dec.blob()
+    seg_lens = dec.list(Decoder.u32)
+    dec.finish()
+    if kind == KIND_TYPED:
+        codec = WIRE_CODECS.get(mtype)
+        if codec is None:
+            raise ValueError(f"typed payload for unknown type {mtype}")
+        data = codec[1](Decoder(payload))
+    elif kind == KIND_VALUE:
+        data = Decoder(payload).value()
+    elif kind == KIND_JSON:
+        data = json.loads(Decoder(payload).blob())
+    else:
+        raise ValueError(f"bad meta kind {kind}")
+    return mtype, seq, from_name, data, seg_lens
 
 
 COMP_MAGIC = b"CTvC"     # on-wire compressed frame (compression_onwire)
@@ -166,9 +229,23 @@ async def read_frame(reader, compressor=None, aead=None) -> bytes:
     if meta_len > MAX_FRAME:
         raise ValueError("oversized meta")
     mb = await reader.readexactly(meta_len)
-    meta = json.loads(mb)
-    total_segs = sum(meta["segs"])
+    total_segs = sum(_meta_seg_lens(mb))
     if total_segs > MAX_FRAME:
         raise ValueError("oversized frame")
     rest = await reader.readexactly(total_segs + 4)
     return hdr + mb + rest
+
+
+def _meta_seg_lens(mb: bytes) -> list[int]:
+    """Just the segment lengths from a meta envelope (what the stream
+    reader needs to size the rest of the frame)."""
+    dec = Decoder(mb)
+    dec.start(1)
+    dec.string()        # t
+    dec.u64()           # seq
+    dec.string()        # from
+    dec.u8()            # kind
+    dec._take(dec.u32())    # skip payload without materializing it
+    lens = dec.list(Decoder.u32)
+    dec.finish()
+    return lens

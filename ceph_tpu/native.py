@@ -77,6 +77,44 @@ def _load():
         return _lib
 
 
+_dencfast = None
+_dencfast_attempted = False
+
+
+def get_dencfast():
+    """The C denc tagged-value codec (native/denc_value.cc), built
+    lazily; None when no toolchain -- callers keep the pure-Python
+    reference implementation as fallback."""
+    global _dencfast, _dencfast_attempted
+    if _dencfast is not None or _dencfast_attempted:
+        return _dencfast
+    with _lib_lock:
+        if _dencfast_attempted:
+            return _dencfast
+        _dencfast_attempted = True
+        so = _NATIVE_DIR / "ceph_tpu_dencfast.so"
+        if not so.exists():
+            try:
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR),
+                     "ceph_tpu_dencfast.so"],
+                    check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        if not so.exists():
+            return None
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "ceph_tpu_dencfast", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception:
+            return None
+        _dencfast = mod
+    return _dencfast
+
+
 def available() -> bool:
     return _load() is not None
 

@@ -78,12 +78,56 @@ def _entry(cls, samples):
     }
 
 
+def _samples_wire():
+    """Wire frames: the denc meta envelope + typed hot-path codecs
+    (msg/wire_types.py) must stay byte-stable -- a drift here breaks
+    rolling upgrades mid-flight, not just on-disk state."""
+    from ..msg import Message
+    m = Message("osd_op", {"pgid": "1.2a", "oid": "obj-7", "tid": 42,
+                           "reqid": ["client.a:ffee", 7],
+                           "ops": [{"op": "write", "offset": 0,
+                                    "length": 3,
+                                    "data": {"seg": 0, "len": 3}}]},
+                segments=[b"abc"])
+    m.seq, m.from_name = 9, "client.a"
+    yield m
+    yield Message("osd_op_reply", {"tid": 42, "epoch": 11,
+                                   "results": [{"ok": True}]})
+    yield Message("osd_op_reply", {"tid": 43, "err": "ENOENT"})
+    yield Message("rep_op", {"pgid": "1.2a", "tid": 5,
+                             "entry": {"oid": "obj-7",
+                                       "version": [9, 140]},
+                             "muts": [{"op": "write", "offset": 0}]})
+    yield Message("rep_op_reply", {"tid": 5, "from_osd": 3})
+    yield Message("osd_ping", {"from_osd": 2, "stamp": 1234.5})
+    # a generic (non-typed) message exercises the tagged-value path
+    yield Message("paxos_begin", {"version": 7, "value": "v" * 20,
+                                  "e": 2, "nested": {"a": [1, None],
+                                                     "b": -1.5}})
+
+
+def _wire_entry():
+    from ..msg import Message
+    return {
+        "samples": _samples_wire,
+        "enc": lambda m: m.encode(),
+        "dec": Message.decode,
+        "dump": lambda m: {"t": m.type, "seq": m.seq,
+                           "from": m.from_name, "data": m.data,
+                           "segs": [s.hex() for s in m.segments]},
+        # frames start with 4-byte magic + u32 meta_len; the envelope
+        # struct_v lives at offset 8 (default heuristic reads byte 0)
+        "ver": lambda b: b[8:9],
+    }
+
+
 TYPES = {
     "PGInfo": _entry(PGInfo, _samples_pginfo),
     "LogEntry": _entry(LogEntry, _samples_logentry),
     "MissingSet": _entry(MissingSet, _samples_missing),
     "PastIntervals": _entry(PastIntervals, _samples_pastintervals),
     "PGLog": _entry(PGLog, _samples_pglog),
+    "WireMessage": _wire_entry(),
 }
 
 
@@ -103,12 +147,14 @@ def corpus_check(root: str) -> int:
                 obj = t["dec"](blob)
                 re = t["enc"](obj)
                 if re != blob:
-                    # the envelope's first byte is the struct version:
-                    # an OLD-version blob is decode-compat only (the
-                    # reference keeps per-version corpus archives the
-                    # same way); a SAME-version mismatch is a breaking
-                    # format drift and fails
-                    if blob[:1] == re[:1]:
+                    # the envelope's version byte (offset per type --
+                    # wire frames carry a magic first): an OLD-version
+                    # blob is decode-compat only (the reference keeps
+                    # per-version corpus archives the same way); a
+                    # SAME-version mismatch is a breaking format
+                    # drift and fails
+                    ver = t.get("ver", lambda b: b[:1])
+                    if ver(blob) == ver(re):
                         print(f"FAIL {tdir.name}/{blob_path.name}: "
                               f"re-encode differs at same version "
                               f"({len(re)} vs {len(blob)} bytes)")

@@ -148,9 +148,20 @@ def test_secure_mode_end_to_end():
     run(main())
 
 
-def test_secure_requires_secret():
-    with pytest.raises(ValueError):
-        Messenger("x", secure=True)
+def test_secure_without_any_key_refuses_connections():
+    """secure=True with no PSK is allowed at construction (a cephx
+    ticket/validator may arrive later), but with NO key source at all
+    every connection must be refused at negotiation."""
+    async def main():
+        srv = Messenger("srv", secure=True)     # keyless
+        await srv.bind()
+        cli = Messenger("cli", secure=True)     # keyless
+        with pytest.raises((ConnectionError, ValueError, OSError)):
+            await cli.send(srv.addr, "srv", Message("m", {}))
+        await cli.shutdown()
+        await srv.shutdown()
+
+    run(main())
 
 
 def test_downgrade_rejected():

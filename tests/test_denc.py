@@ -191,3 +191,126 @@ def test_pg_meta_json_upgrade(tmp_path):
     pg2 = PG(osd, "1.0", FakePool(), None)
     assert pg2.info.last_update == EVersion(3, 9)
     assert pg2.log.entries[0].reqid == ("c:1", 4)
+
+
+# -- wire meta: denc replaces JSON (round-4 review weak #3) -------------------
+
+def test_wire_frame_carries_no_json():
+    """Hot-path frames must not contain JSON: the meta envelope is
+    denc, the payload is a typed codec (msg/wire_types.py)."""
+    from ceph_tpu.msg import Message
+    m = Message("osd_op", {"pgid": "1.2a", "oid": "obj", "tid": 1,
+                           "reqid": ["c:1", 1],
+                           "ops": [{"op": "read", "offset": 0,
+                                    "length": 100}]})
+    buf = m.encode()
+    assert b'"pgid"' not in buf and b'{"' not in buf
+    assert Message.decode(buf).data == m.data
+
+
+def test_typed_codec_roundtrip_fidelity():
+    """decode(encode(d)) == d EXACTLY for the typed hot-path messages:
+    absent keys stay absent (handlers distinguish missing from
+    default), extra keys survive via the extras dict."""
+    from ceph_tpu.msg import Message
+    from ceph_tpu.msg.wire_types import WIRE_CODECS
+    cases = {
+        "osd_op": [{"pgid": "1.0", "oid": "o", "tid": 3,
+                    "reqid": ["c:i", 9], "ops": [{"op": "stat"}],
+                    "flags": ["balance_reads"]},
+                   {"pgid": "1.0", "oid": "o", "ops": []},
+                   {}],
+        "osd_op_reply": [{"tid": 3, "epoch": 7,
+                          "results": [{"len": 10}]},
+                         {"tid": 3, "err": "EAGAIN"}, {}],
+        "rep_op": [{"pgid": "2.1", "tid": 8, "entry": {"v": [1, 2]},
+                    "muts": [], "log_only": True}, {}],
+        "rep_op_reply": [{"tid": 8, "from_osd": 0}, {}],
+        "osd_ping": [{"from_osd": 4, "stamp": 99.25,
+                      "hb_epoch": 3}, {}],
+    }
+    for mtype, datas in cases.items():
+        assert mtype in WIRE_CODECS
+        for data in datas:
+            m = Message(mtype, data)
+            got = Message.decode(m.encode()).data
+            assert got == data, f"{mtype}: {got} != {data}"
+
+
+def test_value_codec_c_and_python_byte_identical():
+    """The C codec (native/denc_value.cc) and the pure-Python
+    reference must produce identical bytes and identical decodes."""
+    import ceph_tpu.common.denc as D
+    v = {"s": "héllo", "i": -5, "big": 1 << 80, "f": 0.5,
+         "none": None, "t": True, "raw": b"\x00\xff",
+         "lst": [1, "two", [3.0, {}]], "nested": {"k": [None, False]},
+         7: "int-key-coerces"}
+    fast = D._fast()
+    if fast is None:
+        pytest.skip("no native toolchain")
+    e1 = D.Encoder(); e1.value(v)
+    e2 = D.Encoder(); e2._value_py(v)
+    assert e1.bytes() == e2.bytes()
+    want = {**{k: vv for k, vv in v.items() if isinstance(k, str)},
+            "7": "int-key-coerces"}
+    assert D.Decoder(e1.bytes()).value() == want
+    assert D.Decoder(e1.bytes())._value_py() == want
+
+
+def test_value_codec_rejects_unencodable():
+    from ceph_tpu.common.denc import DencError, Encoder
+    with pytest.raises(DencError):
+        Encoder().value({"bad": object()})
+
+
+def test_value_decode_respects_envelope_bounds():
+    """A value payload must not read past its envelope into sibling
+    data (lying length or truncated tag stream)."""
+    from ceph_tpu.common.denc import Decoder, DencError, Encoder
+    enc = Encoder()
+    enc.start(1, 1)
+    inner = Encoder(); inner.value("abcdef")
+    # truncate the inner value: claim the envelope ends mid-string
+    enc.buf += inner.bytes()[:4]
+    enc.finish()
+    enc.string("sibling")
+    dec = Decoder(enc.bytes())
+    dec.start(1)
+    with pytest.raises(DencError):
+        dec.value()
+
+
+def test_typed_codec_preserves_explicit_none_and_false():
+    """Explicit None for a fixed field and log_only tri-state must
+    round-trip exactly (review finding: optional-field encoding
+    conflated them with absent)."""
+    from ceph_tpu.msg import Message
+    for mtype, data in (
+            ("osd_op_reply", {"tid": None, "epoch": 4}),
+            ("rep_op", {"pgid": "1.0", "log_only": False}),
+            ("rep_op", {"pgid": "1.0", "log_only": True}),
+            ("osd_op", {"oid": "o", "reqid": None})):
+        got = Message.decode(Message(mtype, data).encode()).data
+        assert got == data, f"{mtype}: {got} != {data}"
+
+
+def test_encode_errors_are_safe():
+    """Unencodable payloads fail with the DencError family (a
+    ValueError, which the read loops treat as a framing error), on
+    both the typed and generic paths; deep nesting is capped
+    identically with and without the C codec."""
+    from ceph_tpu.msg import Message
+    with pytest.raises(ValueError):
+        Message("osd_op", {"ops": object()}).encode()
+    with pytest.raises(ValueError):
+        Message("anything", {"x": object()}).encode()
+    # >200-deep nesting exceeds the denc cap but fits json's: the
+    # escape hatch carries it, transparently to the receiver
+    deep = "leaf"
+    for _ in range(300):
+        deep = [deep]
+    m2 = Message.decode(Message("anything", {"deep": deep}).encode())
+    assert m2.data["deep"] == deep
+    import ceph_tpu.common.denc as D
+    with pytest.raises(D.DencError):
+        D.Encoder()._value_py({"deep": deep})

@@ -6,9 +6,13 @@ collections (one per PG) of objects, each with byte data, xattrs, and an
 omap; all mutations batched in atomic Transactions.
 
 Backends: MemStore (RAM, tests/dev -- the reference has src/os/memstore);
-DBStore (SQLite WAL -- the RocksDB-backed BlueStore role: atomic commit
-via the WAL journal, data+metadata+omap in one transactional store).
+DBStore (SQLite WAL, relational schema); KVStore (everything through
+the KeyValueDB abstraction -- the kstore role, os/kv.py holding the
+KeyValueDB.h contract); BlockStore (raw-block BlueStore analog with
+KV-backed metadata -- the performance store).
 """
 
 from .transaction import Transaction  # noqa: F401
 from .store import ObjectStore, MemStore, DBStore  # noqa: F401
+from .kv import KeyValueDB, KVTransaction, MemKVDB, SqliteKVDB  # noqa: F401
+from .kvstore import KVStore  # noqa: F401

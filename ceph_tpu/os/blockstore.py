@@ -1,4 +1,5 @@
-"""BlockStore: raw-file block store with allocator, WAL, and checksums.
+"""BlockStore: raw-file block store with allocator, WAL, checksums,
+and KV-backed metadata.
 
 The BlueStore analog (src/os/bluestore/BlueStore.cc): object data lives
 in a single raw block file this store ALLOCATES itself -- no filesystem
@@ -18,12 +19,17 @@ per object, no sqlite row per write.  The moving parts map one-to-one:
     BlueStore verify_csum);
   * clones share blocks by refcount (SharedBlob); a deferred in-place
     write to a shared block is forced down the redirect path (COW);
-  * metadata (onodes: size, block map, csums, xattrs, omap) lives in
-    memory, checkpointed to a sidecar file when the WAL grows past a
-    bound; mount() loads the checkpoint and replays the WAL tail.
+  * metadata (onodes: size, block map, xattrs; omap; per-block csums)
+    lives in a KeyValueDB (os/kv.py -- the KeyValueDB.h role, sqlite
+    engine) exactly as BlueStore keeps onodes in RocksDB: a bounded
+    LRU onode cache serves reads, mutations accumulate as in-memory
+    dirty overlays, and a checkpoint flushes ONLY the dirty entries in
+    one atomic KV batch before truncating the WAL.  Memory stays
+    bounded at any object count; checkpoints are incremental, not
+    wholesale.
 
-Layout under ``path/``: ``block`` (data), ``wal`` (log), ``ckpt``
-(metadata snapshot).
+Layout under ``path/``: ``block`` (data), ``wal`` (log), ``md.db``
+(KeyValueDB).
 """
 
 from __future__ import annotations
@@ -32,8 +38,11 @@ import json
 import os
 import struct
 import threading
+from collections import OrderedDict
 
+from ..common.denc import Decoder, Encoder
 from ..native import crc32c
+from .kv import SqliteKVDB
 from .store import ObjectStore
 from .transaction import Transaction
 
@@ -42,36 +51,61 @@ DEFERRED_MAX = 16 * BLOCK        # <=64 KiB writes take the WAL path
 WAL_CKPT_BYTES = 8 << 20         # checkpoint + truncate past this
 QUAR_MAX_BLOCKS = 4096           # force a checkpoint past 16 MiB of
                                  # quarantined frees (space amp bound)
+ONODE_CACHE_MAX = 512            # clean onodes held in RAM
+CSUM_CACHE_MAX = 1 << 16         # cached per-block crcs
 REC_MAGIC = b"BSR1"
+
+# KV prefixes (BlueStore's column families)
+P_ONODE = "O"       # c\0o -> onode blob (size, blocks, xattrs)
+P_OMAP = "M"        # c\0o\0key -> value
+P_CSUM = "C"        # u64be(dev) -> u32le(crc)
+P_STATE = "S"       # "seq" -> u64le
+P_COLL = "L"        # coll -> b""
 
 
 def _crc(data) -> int:
     return crc32c(bytes(data))
 
 
+def _okey(c: str, o: str) -> bytes:
+    return f"{c}\x00{o}".encode()
+
+
+def _mkey(c: str, o: str, k: str = "") -> bytes:
+    return f"{c}\x00{o}\x00{k}".encode()
+
+
 class _Onode:
-    __slots__ = ("size", "blocks", "xattrs", "omap")
+    __slots__ = ("size", "blocks", "xattrs", "dirty")
 
     def __init__(self) -> None:
         self.size = 0
         self.blocks: dict[int, int] = {}    # logical blk -> device blk
         self.xattrs: dict[str, bytes] = {}
-        self.omap: dict[str, bytes] = {}
+        self.dirty = True                   # new onodes need a flush
 
-    def to_json(self) -> dict:
-        return {"size": self.size,
-                "blocks": {str(k): v for k, v in self.blocks.items()},
-                "xattrs": {k: v.hex() for k, v in self.xattrs.items()},
-                "omap": {k: v.hex() for k, v in self.omap.items()}}
+    def encode(self) -> bytes:
+        enc = Encoder()
+        enc.start(1, 1)
+        enc.u64(self.size)
+        enc.map(self.blocks, lambda e, k: e.u64(k),
+                lambda e, v: e.u64(v))
+        enc.map(self.xattrs, lambda e, k: e.string(k),
+                lambda e, v: e.blob(v))
+        enc.finish()
+        return enc.bytes()
 
     @classmethod
-    def from_json(cls, d: dict) -> "_Onode":
-        o = cls()
-        o.size = d["size"]
-        o.blocks = {int(k): v for k, v in d["blocks"].items()}
-        o.xattrs = {k: bytes.fromhex(v) for k, v in d["xattrs"].items()}
-        o.omap = {k: bytes.fromhex(v) for k, v in d["omap"].items()}
-        return o
+    def decode(cls, blob: bytes) -> "_Onode":
+        dec = Decoder(blob)
+        dec.start(1)
+        on = cls()
+        on.size = dec.u64()
+        on.blocks = dec.map(Decoder.u64, Decoder.u64)
+        on.xattrs = dec.map(Decoder.string, Decoder.blob)
+        dec.finish()
+        on.dirty = False
+        return on
 
 
 class Allocator:
@@ -119,9 +153,8 @@ class BlockStore(ObjectStore):
     def __init__(self, path: str) -> None:
         self.path = path
         os.makedirs(path, exist_ok=True)
-        # colls / csum (device blk -> crc32c) / refcnt (shared blocks
-        # only) / alloc / _seq / _pending / _quarantine / _failed are
-        # disk-derived: (re)set in _reset_state at every mount
+        self.kv: SqliteKVDB | None = None
+        # in-memory state is disk-derived: (re)set at every mount
         self._reset_state()
         self._block_fd = -1
         self._wal_fd = -1
@@ -143,12 +176,29 @@ class BlockStore(ObjectStore):
         return os.path.join(self.path, name)
 
     def _reset_state(self) -> None:
-        """In-memory state that must be rebuilt from disk truth at
-        every mount (a prior failed txn leaves nothing behind)."""
-        self.colls: dict[str, dict[str, _Onode]] = {}
-        self.csum: dict[int, int] = {}      # device blk -> crc32c
-        self.refcnt: dict[int, int] = {}    # shared blocks only (>1)
+        """In-memory state rebuilt from disk truth at every mount (a
+        prior failed txn leaves nothing behind).  Everything here is
+        an OVERLAY over the KV: committed-but-not-checkpointed
+        mutations, bounded caches, and the allocator."""
+        # bounded LRU of onodes; dirty entries are flush-pinned (never
+        # evicted until a checkpoint writes them to the KV)
+        self._oncache: OrderedDict[tuple, _Onode] = OrderedDict()
+        # objects removed since the last checkpoint (pending KV rm)
+        self._removed: set[tuple] = set()
+        # omap overlay: (c,o) -> {key -> value | None=deleted}
+        self._om_dirty: dict[tuple, dict[str, bytes | None]] = {}
+        # full-clear markers (applied before the overlay on reads;
+        # rm_range at checkpoint) -- also shields a recreated object
+        # from its prior incarnation's KV rows
+        self._om_cleared: set[tuple] = set()
+        # csum overlay + bounded cache (dev -> crc | None=dropped)
+        self._csum_dirty: dict[int, int | None] = {}
+        self._csum_cache: OrderedDict[int, int] = OrderedDict()
+        # collections: tiny cardinality, full set in RAM
+        self._coll_set: set[str] = set()
+        self._coll_dirty: dict[str, bool] = {}   # c -> exists
         self.alloc = Allocator()
+        self.refcnt: dict[int, int] = {}    # shared blocks only (>1)
         self._seq = 0
         # deferred writes staged this txn but not yet on the device:
         # later ops in the SAME txn must read through this overlay
@@ -162,6 +212,10 @@ class BlockStore(ObjectStore):
         # a txn that died mid-commit leaves memory inconsistent with
         # the log: refuse further work, like BlueStore's abort path
         self._failed = False
+        # observability: KV ops in the last checkpoint batch (proves
+        # incremental flushing -- tests assert it stays proportional
+        # to the delta, not the store size)
+        self._last_ckpt_ops = 0
 
     def mount(self) -> None:
         if self._mounted:
@@ -169,7 +223,11 @@ class BlockStore(ObjectStore):
         self._reset_state()
         self._block_fd = os.open(self._f("block"),
                                  os.O_RDWR | os.O_CREAT, 0o644)
-        self._load_checkpoint()
+        self.kv = SqliteKVDB(self._f("md.db"))
+        seq = self.kv.get(P_STATE, b"seq")
+        self._seq = struct.unpack("<Q", seq)[0] if seq else 0
+        self._coll_set = {k.decode()
+                          for k, _ in self.kv.get_range(P_COLL)}
         good = self._replay_wal()
         self._rebuild_allocator()
         self._wal_fd = os.open(self._f("wal"),
@@ -206,6 +264,7 @@ class BlockStore(ObjectStore):
         # record) is the only consistent truth; remount replays it
         os.close(self._wal_fd)
         os.close(self._block_fd)
+        self.kv.close()
         self._mounted = False
 
     def _ensure(self) -> None:
@@ -257,16 +316,16 @@ class BlockStore(ObjectStore):
         fsync (~0.1-1 ms) per txn -- acceptable against multi-second
         heartbeat grace, and the price of ack==durable semantics."""
         self._ensure()
-        # validate-then-apply, as MemStore: missing collections fail
-        # the whole transaction up front (mkcolls earlier in the same
-        # txn count)
-        pending = set(self.colls)
-        for op in txn.ops:
-            if op.op == "mkcoll":
-                pending.add(op.coll)
-            elif op.coll not in pending:
-                raise KeyError(f"no collection {op.coll}")
         with self._txn_lock:
+            # validate-then-apply, as MemStore: missing collections
+            # fail the whole transaction up front (mkcolls earlier in
+            # the same txn count); under the lock so the set is stable
+            pending = set(self._coll_set)
+            for op in txn.ops:
+                if op.op == "mkcoll":
+                    pending.add(op.coll)
+                elif op.coll not in pending:
+                    raise KeyError(f"no collection {op.coll}")
             if self._failed:
                 raise IOError("blockstore failed mid-commit; "
                               "remount required")
@@ -300,6 +359,7 @@ class BlockStore(ObjectStore):
             os.pwrite(self._block_fd, content, dev * BLOCK)
         self._quarantine.update(ctx["to_release"])
         self._pending.clear()
+        self._evict()
         if (self._wal_size > WAL_CKPT_BYTES
                 or len(self._quarantine) > QUAR_MAX_BLOCKS):
             self._checkpoint()
@@ -311,15 +371,18 @@ class BlockStore(ObjectStore):
         c, oid = op.coll, op.oid
         a = op.args
         if op.op == "mkcoll":
-            self.colls.setdefault(c, {})
+            if c not in self._coll_set:
+                self._coll_set.add(c)
+                self._coll_dirty[c] = True
             delta["ops"].append({"op": "mkcoll", "c": c})
         elif op.op == "rmcoll":
-            for o in list(self.colls.get(c, {})):
+            for o in self._list_objects(c):
                 self._free_object(c, o, ctx)
-            self.colls.pop(c, None)
+            self._coll_set.discard(c)
+            self._coll_dirty[c] = False
             delta["ops"].append({"op": "rmcoll", "c": c})
         elif op.op == "touch":
-            self.colls.setdefault(c, {}).setdefault(oid, _Onode())
+            self._onode(c, oid, create=True)
             delta["ops"].append({"op": "touch", "c": c, "o": oid})
         elif op.op == "write":
             self._do_write(c, oid, a["offset"], a["data"], delta, ctx)
@@ -336,41 +399,96 @@ class BlockStore(ObjectStore):
         elif op.op == "setattr":
             on = self._onode(c, oid, create=True)
             on.xattrs[a["name"]] = a["value"]
+            on.dirty = True
             delta["ops"].append({"op": "setattr", "c": c, "o": oid,
                                  "n": a["name"],
                                  "v": a["value"].hex()})
         elif op.op == "rmattr":
             on = self._onode(c, oid, create=True)
             on.xattrs.pop(a["name"], None)
+            on.dirty = True
             delta["ops"].append({"op": "rmattr", "c": c, "o": oid,
                                  "n": a["name"]})
         elif op.op == "omap_setkeys":
-            on = self._onode(c, oid, create=True)
-            on.omap.update(a["kv"])
+            self._onode(c, oid, create=True)
+            self._om_dirty.setdefault((c, oid), {}).update(a["kv"])
             delta["ops"].append({"op": "omap_setkeys", "c": c,
                                  "o": oid,
                                  "kv": {k: v.hex()
                                         for k, v in a["kv"].items()}})
         elif op.op == "omap_rmkeys":
-            on = self._onode(c, oid, create=True)
+            self._onode(c, oid, create=True)
+            d = self._om_dirty.setdefault((c, oid), {})
             for k in a["keys"]:
-                on.omap.pop(k, None)
+                d[k] = None
             delta["ops"].append({"op": "omap_rmkeys", "c": c, "o": oid,
                                  "keys": list(a["keys"])})
         elif op.op == "omap_clear":
-            on = self._onode(c, oid, create=True)
-            on.omap.clear()
+            self._onode(c, oid, create=True)
+            self._om_cleared.add((c, oid))
+            self._om_dirty.pop((c, oid), None)
             delta["ops"].append({"op": "omap_clear", "c": c, "o": oid})
         else:
             raise ValueError(f"unknown op {op.op}")
 
-    # -- data path ------------------------------------------------------------
-    def _onode(self, c: str, oid: str, create: bool = False) -> _Onode:
-        coll = self.colls.setdefault(c, {}) if create else self.colls[c]
-        if create:
-            return coll.setdefault(oid, _Onode())
-        return coll[oid]
+    # -- onode cache ----------------------------------------------------------
+    def _onode(self, c: str, oid: str,
+               create: bool = False) -> _Onode | None:
+        key = (c, oid)
+        on = self._oncache.get(key)
+        if on is not None:
+            self._oncache.move_to_end(key)
+            return on
+        if key not in self._removed:
+            blob = self.kv.get(P_ONODE, _okey(c, oid)) \
+                if self.kv is not None else None
+            if blob is not None:
+                on = _Onode.decode(blob)
+                self._oncache[key] = on
+                self._evict()    # read-heavy paths must stay bounded
+                return on
+        if not create:
+            return None
+        self._removed.discard(key)
+        on = _Onode()
+        self._oncache[key] = on
+        return on
 
+    def _evict(self) -> None:
+        """Drop least-recently-used CLEAN onodes past the cache bound;
+        dirty onodes are pinned until a checkpoint flushes them."""
+        while len(self._csum_cache) > CSUM_CACHE_MAX:
+            self._csum_cache.popitem(last=False)
+        excess = len(self._oncache) - ONODE_CACHE_MAX
+        if excess <= 0:
+            return
+        for key in [k for k, v in self._oncache.items()
+                    if not v.dirty][:excess]:
+            del self._oncache[key]
+
+    # -- csums ----------------------------------------------------------------
+    def _get_csum(self, dev: int) -> int | None:
+        if dev in self._csum_dirty:
+            return self._csum_dirty[dev]
+        got = self._csum_cache.get(dev)
+        if got is not None:
+            self._csum_cache.move_to_end(dev)
+            return got
+        raw = self.kv.get(P_CSUM, struct.pack(">Q", dev))
+        if raw is None:
+            return None
+        crc = struct.unpack("<I", raw)[0]
+        self._csum_cache[dev] = crc
+        return crc
+
+    def _set_csum(self, dev: int, crc: int | None) -> None:
+        self._csum_dirty[dev] = crc
+        if crc is None:
+            self._csum_cache.pop(dev, None)
+        else:
+            self._csum_cache[dev] = crc
+
+    # -- data path ------------------------------------------------------------
     def _read_dev_block(self, dev_blk: int, verify: bool = True) -> bytes:
         pend = self._pending.get(dev_blk)
         if pend is not None:
@@ -378,7 +496,7 @@ class BlockStore(ObjectStore):
         buf = os.pread(self._block_fd, BLOCK, dev_blk * BLOCK)
         buf = buf.ljust(BLOCK, b"\x00")
         if verify:
-            want = self.csum.get(dev_blk)
+            want = self._get_csum(dev_blk)
             if want is not None and _crc(buf) != want:
                 raise IOError(
                     f"checksum mismatch on device block {dev_blk}")
@@ -390,7 +508,7 @@ class BlockStore(ObjectStore):
             self.refcnt[dev_blk] = n - 1
         else:
             self.refcnt.pop(dev_blk, None)
-            self.csum.pop(dev_blk, None)
+            self._set_csum(dev_blk, None)
             # never straight back to the allocator: a live WAL record
             # (this txn's or an earlier uncheckpointed one) may carry a
             # deferred payload for this block, and replay would smear
@@ -448,8 +566,10 @@ class BlockStore(ObjectStore):
         for dev, content in pwrites:
             os.pwrite(self._block_fd, content, dev * BLOCK)
         on.blocks.update(assign)
-        self.csum.update(csums)
+        for dev, crc in csums.items():
+            self._set_csum(dev, crc)
         on.size = max(on.size, end)
+        on.dirty = True
         delta["ops"].append({
             "op": "write", "c": c, "o": oid, "size": on.size,
             "assign": {str(k): v for k, v in assign.items()},
@@ -472,50 +592,71 @@ class BlockStore(ObjectStore):
                            b"\x00" * (BLOCK - size % BLOCK), delta,
                            ctx)
         on.size = size
+        on.dirty = True
         delta["ops"].append({"op": "truncate", "c": c, "o": oid,
                              "size": size})
 
     def _do_clone(self, c: str, src: str, dst: str,
                   delta: dict, ctx: dict) -> None:
-        if src not in self.colls.get(c, {}):
-            return                      # MemStore contract: no-op
         son = self._onode(c, src)
+        if son is None:
+            return                      # MemStore contract: no-op
+        src_omap = self._omap_get(c, src)
         self._free_object(c, dst, ctx)
         don = self._onode(c, dst, create=True)
         don.size = son.size
         don.blocks = dict(son.blocks)
         don.xattrs = dict(son.xattrs)
-        don.omap = dict(son.omap)
+        don.dirty = True
+        self._om_cleared.add((c, dst))
+        self._om_dirty[(c, dst)] = dict(src_omap)
         for dev in son.blocks.values():
             self.refcnt[dev] = self.refcnt.get(dev, 1) + 1
-        delta["ops"].append({"op": "clone", "c": c, "o": src,
-                             "dst": dst})
+        # the record carries the COPIED state: replay must not re-read
+        # the source, which a checkpoint that landed before the crash
+        # may have advanced past the clone point (idempotent replay)
+        delta["ops"].append({
+            "op": "clone", "c": c, "o": src, "dst": dst,
+            "size": don.size,
+            "blocks": {str(k): v for k, v in don.blocks.items()},
+            "xattrs": {k: v.hex() for k, v in don.xattrs.items()},
+            "omap": {k: v.hex() for k, v in src_omap.items()}})
 
     def _free_object(self, c: str, oid: str, ctx: dict) -> None:
-        on = self.colls.get(c, {}).pop(oid, None)
-        if on is not None:
-            for dev in on.blocks.values():
-                self._deref(dev, ctx)
+        on = self._onode(c, oid)
+        if on is None:
+            return
+        for dev in on.blocks.values():
+            self._deref(dev, ctx)
+        self._oncache.pop((c, oid), None)
+        self._removed.add((c, oid))
+        self._om_dirty.pop((c, oid), None)
+        self._om_cleared.add((c, oid))
 
     # -- replay / checkpoint --------------------------------------------------
     def _replay_op(self, d: dict) -> None:
         op, c = d["op"], d.get("c")
         oid = d.get("o")
+        ctx = {"sync": False, "deferred": [], "to_release": []}
         if op == "mkcoll":
-            self.colls.setdefault(c, {})
+            if c not in self._coll_set:
+                self._coll_set.add(c)
+                self._coll_dirty[c] = True
         elif op == "rmcoll":
-            for o in list(self.colls.get(c, {})):
-                self.colls[c].pop(o)
-            self.colls.pop(c, None)
+            for o in self._list_objects(c):
+                self._free_object(c, o, ctx)
+            self._coll_set.discard(c)
+            self._coll_dirty[c] = False
         elif op == "touch":
-            self.colls.setdefault(c, {}).setdefault(oid, _Onode())
+            self._onode(c, oid, create=True)
         elif op == "write":
             on = self._onode(c, oid, create=True)
             assign = {int(k): v for k, v in d["assign"].items()}
             on.blocks.update(assign)
             on.size = max(on.size, d["size"])
-            self.csum.update({int(k): v
-                              for k, v in d["csums"].items()})
+            on.dirty = True
+            for k, v in d["csums"].items():
+                self._set_csum(int(k), v)
             for dev, hexdata in d["payloads"]:
                 os.pwrite(self._block_fd, bytes.fromhex(hexdata),
                           dev * BLOCK)
@@ -525,31 +666,47 @@ class BlockStore(ObjectStore):
             for lb in [b for b in on.blocks if b >= keep]:
                 on.blocks.pop(lb)
             on.size = d["size"]
+            on.dirty = True
         elif op == "remove":
-            self.colls.get(c, {}).pop(oid, None)
+            on = self._onode(c, oid)
+            if on is not None:
+                self._oncache.pop((c, oid), None)
+                self._removed.add((c, oid))
+                self._om_dirty.pop((c, oid), None)
+                self._om_cleared.add((c, oid))
         elif op == "clone":
-            son = self.colls.get(c, {}).get(oid)
-            if son is not None:
-                don = _Onode()
-                don.size = son.size
-                don.blocks = dict(son.blocks)
-                don.xattrs = dict(son.xattrs)
-                don.omap = dict(son.omap)
-                self.colls[c][d["dst"]] = don
+            # self-contained: the record's copied state, never the
+            # source's current (possibly post-checkpoint) state
+            don = self._onode(c, d["dst"], create=True)
+            don.size = d["size"]
+            don.blocks = {int(k): v for k, v in d["blocks"].items()}
+            don.xattrs = {k: bytes.fromhex(v)
+                          for k, v in d["xattrs"].items()}
+            don.dirty = True
+            self._om_cleared.add((c, d["dst"]))
+            self._om_dirty[(c, d["dst"])] = {
+                k: bytes.fromhex(v) for k, v in d["omap"].items()}
         elif op == "setattr":
-            self._onode(c, oid, create=True).xattrs[d["n"]] = \
-                bytes.fromhex(d["v"])
+            on = self._onode(c, oid, create=True)
+            on.xattrs[d["n"]] = bytes.fromhex(d["v"])
+            on.dirty = True
         elif op == "rmattr":
-            self._onode(c, oid, create=True).xattrs.pop(d["n"], None)
+            on = self._onode(c, oid, create=True)
+            on.xattrs.pop(d["n"], None)
+            on.dirty = True
         elif op == "omap_setkeys":
-            self._onode(c, oid, create=True).omap.update(
+            self._onode(c, oid, create=True)
+            self._om_dirty.setdefault((c, oid), {}).update(
                 {k: bytes.fromhex(v) for k, v in d["kv"].items()})
         elif op == "omap_rmkeys":
-            on = self._onode(c, oid, create=True)
+            self._onode(c, oid, create=True)
+            od = self._om_dirty.setdefault((c, oid), {})
             for k in d["keys"]:
-                on.omap.pop(k, None)
+                od[k] = None
         elif op == "omap_clear":
-            self._onode(c, oid, create=True).omap.clear()
+            self._onode(c, oid, create=True)
+            self._om_cleared.add((c, oid))
+            self._om_dirty.pop((c, oid), None)
 
     def _replay_wal(self) -> int:
         """Apply intact records; returns the byte offset of the first
@@ -574,38 +731,84 @@ class BlockStore(ObjectStore):
             pos += 12 + ln
         return pos
 
+    def _all_onodes(self):
+        """(key, onode) for every live object: KV rows shadowed by the
+        cache/removed overlay, then dirty cache-only entries."""
+        seen = set()
+        if self.kv is not None:
+            for kraw, blob in self.kv.get_range(P_ONODE):
+                c, _, o = kraw.decode().partition("\x00")
+                key = (c, o)
+                if key in self._removed:
+                    continue
+                seen.add(key)
+                on = self._oncache.get(key)
+                yield key, (on if on is not None
+                            else _Onode.decode(blob))
+        for key, on in list(self._oncache.items()):
+            if key not in seen and key not in self._removed:
+                yield key, on
+
     def _rebuild_allocator(self) -> None:
         """Used-block census from the onode maps (mount-time fsck the
         way BlueStore rebuilds its freelist)."""
         used: dict[int, int] = {}
-        for coll in self.colls.values():
-            for on in coll.values():
-                for dev in on.blocks.values():
-                    used[dev] = used.get(dev, 0) + 1
+        for _, on in self._all_onodes():
+            for dev in on.blocks.values():
+                used[dev] = used.get(dev, 0) + 1
         self.refcnt = {b: n for b, n in used.items() if n > 1}
         high = max(used, default=-1) + 1
         self.alloc.high = high
         self.alloc.free = set(range(high)) - set(used)
-        # checksums for blocks that predate the checkpoint were loaded
-        # from it; drop csums for freed blocks
-        self.csum = {b: s for b, s in self.csum.items() if b in used}
 
     def _checkpoint(self) -> None:
-        state = {
-            "seq": self._seq,
-            "colls": {c: {o: on.to_json() for o, on in objs.items()}
-                      for c, objs in self.colls.items()},
-            "csum": {str(k): v for k, v in self.csum.items()},
-        }
-        blob = json.dumps(state, separators=(",", ":")).encode()
-        tmp = self._f("ckpt.tmp")
-        with open(tmp, "wb") as f:
-            f.write(struct.pack("<I", _crc(blob)) + blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._f("ckpt"))
-        # data must be on disk before the log that re-creates it is cut
+        """Flush the dirty overlays -- and ONLY them -- to the KV in
+        one atomic batch, then truncate the WAL (BlueStore's kv_sync
+        commit; incremental where the old design rewrote everything)."""
+        kvt = self.kv.transaction()
+        nops = 1
+        kvt.set(P_STATE, b"seq", struct.pack("<Q", self._seq))
+        for c, exists in self._coll_dirty.items():
+            nops += 1
+            if exists:
+                kvt.set(P_COLL, c.encode(), b"")
+            else:
+                kvt.rm(P_COLL, c.encode())
+        for (c, o) in self._removed:
+            nops += 1
+            kvt.rm(P_ONODE, _okey(c, o))
+        for (c, o) in self._om_cleared:
+            nops += 1
+            kvt.rm_range(P_OMAP, _mkey(c, o), _mkey(c, o) + b"\xff")
+        for key, on in self._oncache.items():
+            if on.dirty:
+                nops += 1
+                kvt.set(P_ONODE, _okey(*key), on.encode())
+        for (c, o), od in self._om_dirty.items():
+            for k, v in od.items():
+                nops += 1
+                if v is None:
+                    kvt.rm(P_OMAP, _mkey(c, o, k))
+                else:
+                    kvt.set(P_OMAP, _mkey(c, o, k), v)
+        for dev, crc in self._csum_dirty.items():
+            nops += 1
+            if crc is None:
+                kvt.rm(P_CSUM, struct.pack(">Q", dev))
+            else:
+                kvt.set(P_CSUM, struct.pack(">Q", dev),
+                        struct.pack("<I", crc))
+        # data must be on disk before the metadata that references it
         os.fsync(self._block_fd)
+        self.kv.submit(kvt, sync=True)
+        self._last_ckpt_ops = nops
+        for on in self._oncache.values():
+            on.dirty = False
+        self._removed.clear()
+        self._om_dirty.clear()
+        self._om_cleared.clear()
+        self._csum_dirty.clear()
+        self._coll_dirty.clear()
         if self._wal_fd >= 0:
             os.ftruncate(self._wal_fd, 0)
             os.fsync(self._wal_fd)
@@ -618,33 +821,24 @@ class BlockStore(ObjectStore):
         if self._quarantine:
             self.alloc.release(self._quarantine)
             self._quarantine.clear()
-
-    def _load_checkpoint(self) -> None:
-        try:
-            with open(self._f("ckpt"), "rb") as f:
-                raw = f.read()
-        except FileNotFoundError:
-            return
-        want, = struct.unpack_from("<I", raw)
-        blob = raw[4:]
-        if _crc(blob) != want:
-            raise IOError("checkpoint checksum mismatch")
-        state = json.loads(blob)
-        self._seq = state["seq"]
-        self.colls = {c: {o: _Onode.from_json(j)
-                          for o, j in objs.items()}
-                      for c, objs in state["colls"].items()}
-        self.csum = {int(k): v for k, v in state["csum"].items()}
+        self._evict()
 
     # -- reads ----------------------------------------------------------------
     def read(self, coll, oid, offset=0, length=None):
         from ..common.throttle import injector
         injector.maybe_raise("objectstore_read")   # EIO injection site
-        self._ensure()
-        objs = self.colls.get(coll)
-        if objs is None or oid not in objs:
+        # reads mutate the shared LRU caches (move_to_end / insert /
+        # evict), so they serialize with writers on the same lock the
+        # txn path holds -- the pre-KV design's lock-free reads were
+        # pure dict lookups, these are not
+        with self._txn_lock:
+            self._ensure()
+            return self._read_locked(coll, oid, offset, length)
+
+    def _read_locked(self, coll, oid, offset=0, length=None):
+        on = self._onode(coll, oid)
+        if coll not in self._coll_set or on is None:
             raise FileNotFoundError(f"{coll}/{oid}")
-        on = objs[oid]
         if length is None:
             length = max(0, on.size - offset)
         length = max(0, min(length, on.size - offset))
@@ -661,41 +855,76 @@ class BlockStore(ObjectStore):
         return bytes(out[s:s + length])
 
     def stat(self, coll, oid):
-        self._ensure()
-        objs = self.colls.get(coll)
-        if objs is None or oid not in objs:
-            return None
-        return {"size": objs[oid].size}
+        with self._txn_lock:
+            self._ensure()
+            on = self._onode(coll, oid)
+            if coll not in self._coll_set or on is None:
+                return None
+            return {"size": on.size}
 
     def getattr(self, coll, oid, name):
-        self._ensure()
-        on = self.colls.get(coll, {}).get(oid)
-        return None if on is None else on.xattrs.get(name)
+        with self._txn_lock:
+            self._ensure()
+            on = self._onode(coll, oid)
+            return None if on is None else on.xattrs.get(name)
 
     def getattrs(self, coll, oid):
-        self._ensure()
-        on = self.colls.get(coll, {}).get(oid)
-        return {} if on is None else dict(on.xattrs)
+        with self._txn_lock:
+            self._ensure()
+            on = self._onode(coll, oid)
+            return {} if on is None else dict(on.xattrs)
 
     def omap_get(self, coll, oid):
-        self._ensure()
-        on = self.colls.get(coll, {}).get(oid)
-        return {} if on is None else dict(on.omap)
+        with self._txn_lock:
+            self._ensure()
+            return self._omap_get(coll, oid)
+
+    def _omap_get(self, coll, oid):
+        key = (coll, oid)
+        out: dict[str, bytes] = {}
+        if key not in self._om_cleared and key not in self._removed \
+                and self.kv is not None:
+            base = _mkey(coll, oid)
+            for kraw, v in self.kv.get_range(P_OMAP, base,
+                                             base + b"\xff"):
+                out[kraw[len(base):].decode()] = v
+        for k, v in self._om_dirty.get(key, {}).items():
+            if v is None:
+                out.pop(k, None)
+            else:
+                out[k] = v
+        return out
 
     def list_collections(self):
-        self._ensure()
-        return sorted(self.colls)
+        with self._txn_lock:
+            self._ensure()
+            return sorted(self._coll_set)
 
     def list_objects(self, coll):
-        self._ensure()
-        return sorted(self.colls.get(coll, {}))
+        with self._txn_lock:
+            self._ensure()
+            return self._list_objects(coll)
+
+    def _list_objects(self, coll):
+        names = set()
+        if self.kv is not None:
+            pref = f"{coll}\x00".encode()
+            for kraw, _ in self.kv.get_range(P_ONODE, pref,
+                                             pref + b"\xff"):
+                names.add(kraw[len(pref):].decode())
+        for (c, o), on in self._oncache.items():
+            if c == coll and on.dirty:
+                names.add(o)
+        names -= {o for (c, o) in self._removed if c == coll}
+        return sorted(names)
 
     def list_objects_range(self, coll, begin, limit):
-        self._ensure()
-        names = [o for o in sorted(self.colls.get(coll, {}))
-                 if o > begin]
-        return names[:limit]
+        with self._txn_lock:
+            self._ensure()
+            names = [o for o in self._list_objects(coll) if o > begin]
+            return names[:limit]
 
     def collection_exists(self, coll):
-        self._ensure()
-        return coll in self.colls
+        with self._txn_lock:
+            self._ensure()
+            return coll in self._coll_set

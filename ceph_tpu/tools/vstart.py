@@ -35,6 +35,9 @@ def _make_store(args, name: str):
     if args.store == "block":
         from ..os.blockstore import BlockStore
         return BlockStore(os.path.join(args.store_dir, name))
+    if args.store == "kv":
+        from ..os.kvstore import KVStore
+        return KVStore(os.path.join(args.store_dir, f"{name}.kv.db"))
     return DBStore(os.path.join(args.store_dir, f"{name}.db"))
 
 
@@ -164,7 +167,7 @@ def main(argv=None) -> int:
     p.add_argument("--mon-addr", default=None,
                    help="mon address for --role osd (host:port)")
     p.add_argument("--osd-index", type=int, default=0)
-    p.add_argument("--store", choices=("mem", "db", "block"),
+    p.add_argument("--store", choices=("mem", "db", "block", "kv"),
                    default="db",
                    help="store backend when --store-dir is set")
     args = p.parse_args(argv)

@@ -90,8 +90,8 @@ def test_clone_shares_then_cows(tmp_path):
     content = os.urandom(2 * BLOCK)
     w(bs, "c", "src", 0, content)
     bs.queue_transaction(Transaction().clone("c", "src", "dst"))
-    src_blocks = set(bs.colls["c"]["src"].blocks.values())
-    dst_blocks = set(bs.colls["c"]["dst"].blocks.values())
+    src_blocks = set(bs._onode("c", "src").blocks.values())
+    dst_blocks = set(bs._onode("c", "dst").blocks.values())
     assert src_blocks == dst_blocks          # shared, not copied
     # writing the source COWs away from the shared blocks
     w(bs, "c", "src", 0, b"Y" * 100)
@@ -108,7 +108,7 @@ def test_checksum_detects_bitrot(tmp_path):
     bs = mk(tmp_path / "s")
     bs.queue_transaction(Transaction().create_collection("c"))
     w(bs, "c", "a", 0, b"precious-data" * 100)
-    dev_blk = next(iter(bs.colls["c"]["a"].blocks.values()))
+    dev_blk = next(iter(bs._onode("c", "a").blocks.values()))
     # flip a byte on the raw device behind the store's back
     with open(bs._f("block"), "r+b") as f:
         f.seek(dev_blk * BLOCK + 7)
@@ -335,11 +335,11 @@ def test_stale_deferred_payload_never_replays_over_reallocated_block(
     bs.queue_transaction(Transaction().create_collection("c"))
     w(bs, "c", "small", 0, b"A" * 100)           # allocates B
     w(bs, "c", "small", 0, b"B" * 100)           # T1: deferred payload
-    devs = set(bs.colls["c"]["small"].blocks.values())
+    devs = set(bs._onode("c", "small").blocks.values())
     bs.queue_transaction(Transaction().remove("c", "small"))  # T2
     big = os.urandom(DEFERRED_MAX + BLOCK)
     w(bs, "c", "big", 0, big)                    # T3: redirect write
-    assert not devs & set(bs.colls["c"]["big"].blocks.values()), \
+    assert not devs & set(bs._onode("c", "big").blocks.values()), \
         "freed block with a live WAL payload was reallocated"
     # crash (no checkpoint), remount: replay must leave big intact
     os.close(bs._block_fd)
@@ -373,3 +373,89 @@ def test_failed_txn_umount_remount_recovers_committed_state(tmp_path):
     w(bs, "c", "a", 0, b"NEXT" * 200)        # recovered: writable
     assert bs.read("c", "a") == b"NEXT" * 200
     bs.umount()
+
+
+def test_metadata_memory_bounded_and_checkpoint_incremental(tmp_path):
+    """Onodes live in the KV (md.db), not in RAM: after writing far
+    more objects than the cache bound, the cache stays bounded, every
+    object remains readable (served from the KV), and a checkpoint
+    after ONE more write flushes a handful of KV ops -- not the whole
+    store (BlueStore's incremental kv_sync, not a wholesale dump)."""
+    from ceph_tpu.os.blockstore import ONODE_CACHE_MAX
+    bs = mk(tmp_path / "s")
+    bs.queue_transaction(Transaction().create_collection("c"))
+    n = ONODE_CACHE_MAX * 3
+    for i in range(n):
+        t = Transaction()
+        t.write("c", f"obj-{i:05d}", 0, f"payload-{i}".encode())
+        t.omap_setkeys("c", f"obj-{i:05d}", {"k": str(i).encode()})
+        bs.queue_transaction(t)
+    bs._checkpoint()
+    assert len(bs._oncache) <= ONODE_CACHE_MAX + 1
+    # all reachable though most onodes are NOT in memory
+    assert len(bs.list_objects("c")) == n
+    for i in (0, 7, n // 2, n - 1):
+        assert bs.read("c", f"obj-{i:05d}") == f"payload-{i}".encode()
+        assert bs.omap_get("c", f"obj-{i:05d}") == {"k": str(i).encode()}
+    # incremental: one more write -> checkpoint touches O(1) KV rows
+    w(bs, "c", "obj-extra", 0, b"tail write")
+    bs._checkpoint()
+    assert bs._last_ckpt_ops < 16, \
+        f"checkpoint flushed {bs._last_ckpt_ops} ops for one write"
+    bs.umount()
+    # cold remount serves everything from the KV
+    bs2 = mk(tmp_path / "s")
+    assert len(bs2.list_objects("c")) == n + 1
+    assert bs2.read("c", f"obj-{n//3:05d}") == f"payload-{n//3}".encode()
+    bs2.umount()
+
+
+def test_omap_clear_and_recreate_does_not_resurrect_old_rows(tmp_path):
+    """A removed object's KV omap rows must not leak into a recreated
+    object of the same name across checkpoints."""
+    bs = mk(tmp_path / "s")
+    bs.queue_transaction(Transaction().create_collection("c"))
+    bs.queue_transaction(
+        Transaction().touch("c", "x")
+        .omap_setkeys("c", "x", {"old": b"1", "both": b"old"}))
+    bs._checkpoint()                       # rows land in the KV
+    bs.queue_transaction(Transaction().remove("c", "x"))
+    bs.queue_transaction(
+        Transaction().touch("c", "x")
+        .omap_setkeys("c", "x", {"both": b"new"}))
+    assert bs.omap_get("c", "x") == {"both": b"new"}
+    bs._checkpoint()
+    assert bs.omap_get("c", "x") == {"both": b"new"}
+    bs.umount()
+    bs2 = mk(tmp_path / "s")
+    assert bs2.omap_get("c", "x") == {"both": b"new"}
+    bs2.umount()
+
+
+def test_clone_replay_idempotent_after_checkpoint_crash(tmp_path):
+    """Crash BETWEEN the checkpoint's KV commit and the WAL truncate:
+    remount replays the whole WAL over the already-checkpointed KV.
+    The clone record must restore dst's clone-time state, not re-copy
+    the source (which the checkpoint advanced past the clone point)."""
+    path = str(tmp_path / "s")
+    bs = mk(path)
+    bs.queue_transaction(Transaction().create_collection("c"))
+    a = b"A" * 900
+    w(bs, "c", "src", 0, a)
+    bs.queue_transaction(
+        Transaction().clone("c", "src", "dst")
+        .omap_setkeys("c", "src", {"k": b"at-clone"}))
+    w(bs, "c", "src", 0, b"B" * 900)          # src moves on
+    wal = open(os.path.join(path, "wal"), "rb").read()
+    bs._checkpoint()                           # KV holds final state
+    # simulate the crash window: WAL truncate never happened
+    with open(os.path.join(path, "wal"), "wb") as f:
+        f.write(wal)
+    os.close(bs._block_fd)
+    bs.kv.close()
+
+    bs2 = BlockStore(path)
+    bs2.mount()
+    assert bs2.read("c", "dst") == a           # clone-time content
+    assert bs2.read("c", "src") == b"B" * 900
+    bs2.umount()

@@ -1,10 +1,10 @@
 import pytest
 
-from ceph_tpu.os import Transaction, MemStore, DBStore
+from ceph_tpu.os import KVStore, Transaction, MemStore, DBStore
 from ceph_tpu.os.blockstore import BlockStore
 
 
-@pytest.fixture(params=["mem", "db", "block"])
+@pytest.fixture(params=["mem", "db", "block", "kv", "kv-sqlite"])
 def store(request, tmp_path):
     if request.param == "mem":
         return MemStore()
@@ -12,6 +12,10 @@ def store(request, tmp_path):
         bs = BlockStore(str(tmp_path / "bs"))
         bs.mount()
         return bs
+    if request.param == "kv":
+        return KVStore()                     # MemKVDB engine
+    if request.param == "kv-sqlite":
+        return KVStore(str(tmp_path / "kv.db"))
     return DBStore(str(tmp_path / "osd.db"))
 
 

@@ -139,6 +139,14 @@ class CephFS:
         self.data = await self.rados.open_ioctx(self.data_pool)
         self.rados.objecter.msgr.add_dispatcher(self._on_reply)
         await self._find_mds()
+        # session heartbeat for the MOUNT's lifetime, not just while
+        # files are open: an MDS successor fences write-cap holders
+        # that stay silent through its reconnect window, and a cap
+        # release journaled by a dying active may be lost -- the
+        # heartbeat is how an innocent client proves it's alive
+        # (the reference's Client::renew_caps runs per-session too)
+        if self._renew_task is None or self._renew_task.done():
+            self._renew_task = asyncio.ensure_future(self._renew_loop())
         return self
 
     async def unmount(self) -> None:
@@ -149,8 +157,6 @@ class CephFS:
     # -- capability bookkeeping ---------------------------------------------
     def _track_file(self, f: FsFile) -> None:
         self._files.setdefault(f.ino, []).append(f)
-        if self._renew_task is None or self._renew_task.done():
-            self._renew_task = asyncio.ensure_future(self._renew_loop())
 
     def _untrack_file(self, f: FsFile) -> None:
         handles = self._files.get(f.ino, [])
@@ -175,10 +181,8 @@ class CephFS:
         they are still trustworthy locally (an unacked lease means the
         MDS may have expired + re-granted them to someone else)."""
         try:
-            while self._files:
+            while True:
                 await asyncio.sleep(CAP_LEASE / 3)
-                if not self._files:
-                    return
                 loop = asyncio.get_event_loop()
                 fut = loop.create_future()
                 self._renew_waiter = fut
@@ -189,7 +193,21 @@ class CephFS:
                     self._note_lease()
                 except (ConnectionError, OSError,
                         asyncio.TimeoutError):
-                    pass               # lease clock keeps draining
+                    # the active may have MOVED (failover): rediscover
+                    # and renew at the new address NOW -- the new
+                    # active fences write-cap holders that stay silent
+                    # past its reconnect window
+                    try:
+                        await self._find_mds()
+                        fut2 = loop.create_future()
+                        self._renew_waiter = fut2
+                        await self._send_to_mds(
+                            Message("session_renew", {}))
+                        await asyncio.wait_for(fut2, 2.0)
+                        self._note_lease()
+                    except (ConnectionError, OSError, RadosError,
+                            asyncio.TimeoutError):
+                        pass           # lease clock keeps draining
                 finally:
                     self._renew_waiter = None
         except asyncio.CancelledError:

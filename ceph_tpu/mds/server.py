@@ -53,6 +53,9 @@ TRIM_EVERY = 64
 BEACON_INTERVAL = 1.0
 BEACON_GRACE = 8.0
 CAP_LEASE = 8.0
+RECONNECT_GRACE = 6.0      # failover window for cap holders to show
+                           # up (> two client renewal periods, so a
+                           # healthy client always makes the window)
 
 DEFAULT_LAYOUT = {"su": 1 << 22, "sc": 1, "os": 1 << 22}
 
@@ -94,7 +97,17 @@ class MDS:
         # caps[ino][client] = {"mode": "r"|"w", "expires": t}
         self.sessions: dict[str, dict] = {}
         self.caps: dict[int, dict[str, dict]] = {}
-        self._revoke_acks: dict[tuple[int, str], asyncio.Event] = {}
+        # a second concurrent revoker must get its OWN event; a single
+        # slot would let one overwrite the other's and strand it for
+        # the full lease (round-4 advisor finding)
+        self._revoke_acks: dict[tuple[int, str],
+                                list[asyncio.Event]] = {}
+        # journaled write-cap holders (client -> {"iid", "inos"}):
+        # replayed at failover so the new active can FENCE holders
+        # that do not reconnect (the reference's reconnect phase +
+        # session-table blocklist, mds/Server.cc reconnect)
+        self._wcap_log: dict[str, dict] = {}
+        self._reconnected: set[str] = set()
         self.mon_addr: tuple[str, int] | None = None
         self.msgr.add_dispatcher(self._dispatch)
 
@@ -254,6 +267,19 @@ class MDS:
             "addr": json.dumps(list(self.addr)).encode(),
             "name": self.name.encode(),
             "epoch": str(int(_now())).encode()})
+        # reconnect-or-fence BEFORE serving: stale write-cap holders
+        # from the previous active must be blocklisted first, and the
+        # survivors' custody re-journaled (replay trimmed the old
+        # records away)
+        await self._reconnect_and_fence()
+        for client, ent in self._wcap_log.items():
+            for ino in ent["inos"]:
+                try:
+                    await self.journal.append(
+                        {"op": "cap_grant_w", "client": client,
+                         "ino": ino, "iid": ent["iid"]})
+                except RadosError:
+                    pass
         self.state = "active"
 
     async def _load_inotable(self) -> None:
@@ -336,6 +362,14 @@ class MDS:
             # write-through: everything journaled is already applied
             self._events_since_trim = 0
             await self.journal.trim()
+            # trim discarded the write-cap custody records; re-journal
+            # them or a failover successor cannot fence pre-trim
+            # holders
+            for client, ent in list(self._wcap_log.items()):
+                for ino in ent["inos"]:
+                    await self.journal.append(
+                        {"op": "cap_grant_w", "client": client,
+                         "ino": ino, "iid": ent["iid"]})
 
     def _remember(self, reqid: str, reply: dict) -> None:
         self._completed[reqid] = reply
@@ -344,6 +378,11 @@ class MDS:
 
     async def _apply_event(self, ev: dict, replay: bool = False) -> None:
         op = ev["op"]
+        if op in ("cap_grant_w", "cap_release_w"):
+            # write-cap custody records: replayed so a failover
+            # successor knows whom to reconnect-or-fence
+            self._apply_wcap(op, ev["client"], ev["ino"], ev["iid"])
+            return
         if op == "link":
             await self.meta.set_omap(dir_oid(ev["dir"]), {
                 ev["name"]: json.dumps(ev["dentry"]).encode()})
@@ -418,47 +457,176 @@ class MDS:
             self.caps.pop(ino, None)
         return self.caps.get(ino, {})
 
+    def _client_iid(self, client: str) -> str:
+        """The client INSTANCE id ("name:incarnation") as it appears
+        in the reqids its Objecter stamps on OSD ops -- the unit the
+        OSDMap blocklist fences."""
+        inst = self.msgr._session_inst.get(client)
+        return f"{client}:{inst}" if inst else client
+
+    async def _fence_client(self, client: str) -> bool:
+        """Blocklist the client instance at the DATA path: a revoked-
+        but-alive client that lost its lease can still have in-flight
+        OSD writes; the OSDs must refuse them before the cap can be
+        handed to someone else (OSDMonitor blocklist; closes the
+        round-4 'caps don't fence the data path' gap).  Returns
+        whether the fence actually landed -- a cap must NOT be
+        re-granted on a failed fence."""
+        iid = self._client_iid(client)
+        for _ in range(3):
+            try:
+                await self.rados.mon_command(
+                    "osd blocklist", {"id": iid, "duration": 600})
+                return True
+            except Exception:
+                await asyncio.sleep(0.2)
+        return False
+
     async def _revoke_cap(self, ino: int, client: str) -> None:
         """Ask ``client`` to flush + release its cap on ``ino``; waits
         for the release ack or the cap's lease expiry, whichever comes
-        first (a dead client cannot wedge the grant)."""
+        first (a dead client cannot wedge the grant).  A holder that
+        NEVER acks is fenced at the OSDs before the cap is freed."""
         cap = self.caps.get(ino, {}).get(client)
         sess = self.sessions.get(client)
         if cap is None:
             return
         ev = asyncio.Event()
-        self._revoke_acks[(ino, client)] = ev
-        if sess is not None and sess.get("conn") is not None:
-            try:
-                await sess["conn"].send(Message(
-                    "cap_revoke", {"ino": ino, "mode": cap["mode"]}))
-            except (ConnectionError, OSError):
-                pass
-        timeout = max(0.1, cap["expires"] - _now())
+        self._revoke_acks.setdefault((ino, client), []).append(ev)
+        deadline = _now() + max(0.1, cap["expires"] - _now())
+        acked = False
         try:
-            await asyncio.wait_for(ev.wait(), timeout)
-        except asyncio.TimeoutError:
-            pass                     # lease lapsed: cap is dead anyway
+            # RE-SEND the revoke while waiting: one lost message must
+            # not escalate a healthy client into a 600s blocklist
+            while _now() < deadline:
+                sess = self.sessions.get(client)
+                if sess is not None and sess.get("conn") is not None:
+                    try:
+                        await sess["conn"].send(Message(
+                            "cap_revoke", {"ino": ino,
+                                           "mode": cap["mode"]}))
+                    except (ConnectionError, OSError):
+                        pass
+                try:
+                    await asyncio.wait_for(
+                        ev.wait(), min(1.0, max(0.05,
+                                                deadline - _now())))
+                    acked = True
+                    break
+                except asyncio.TimeoutError:
+                    continue
+            if not acked and cap["mode"] == "w":
+                # lease lapsed with no release ack: the holder may be
+                # wedged with dirty data in flight -- fence it.  If
+                # the fence cannot land, the cap must not be freed
+                # (the opener gets EAGAIN rather than a second writer)
+                if not await self._fence_client(client):
+                    raise FsOpError(
+                        "EAGAIN", "cannot fence stale cap holder")
         finally:
-            self._revoke_acks.pop((ino, client), None)
-        self.caps.get(ino, {}).pop(client, None)
+            lst = self._revoke_acks.get((ino, client))
+            if lst is not None:
+                if ev in lst:
+                    lst.remove(ev)
+                if not lst:
+                    self._revoke_acks.pop((ino, client), None)
+        if self.caps.get(ino, {}).pop(client, None) is not None \
+                and cap["mode"] == "w":
+            await self._journal_wcap("cap_release_w", ino, client)
 
     async def _acquire_caps(self, ino: int, client: str,
                             want: str) -> str:
         """Grant ``want`` ("r" or "w") on ``ino`` to ``client``,
         revoking conflicting holders first: one writer XOR many
-        readers (the Fr/Fw subset of the cap lattice)."""
-        holders = self._prune_caps(ino)
+        readers (the Fr/Fw subset of the cap lattice).  Conflicts are
+        RECOMPUTED after every awaited revoke: a second opener may
+        have been granted while we waited, and granting on a stale
+        snapshot would seat two writers (round-4 advisor finding)."""
+        while True:
+            holders = self._prune_caps(ino)
+            if want == "w":
+                conflicts = [c for c in holders if c != client]
+            else:
+                conflicts = [c for c, cap in holders.items()
+                             if c != client and cap["mode"] == "w"]
+            if not conflicts:
+                break
+            await self._revoke_cap(ino, conflicts[0])
         if want == "w":
-            conflicts = [c for c in holders if c != client]
-        else:
-            conflicts = [c for c, cap in holders.items()
-                         if c != client and cap["mode"] == "w"]
-        for other in conflicts:
-            await self._revoke_cap(ino, other)
+            await self._journal_wcap("cap_grant_w", ino, client)
         self.caps.setdefault(ino, {})[client] = {
             "mode": want, "expires": _now() + CAP_LEASE}
         return want
+
+    async def _journal_wcap(self, etype: str, ino: int,
+                            client: str) -> None:
+        """Durably record write-cap custody so a FAILOVER successor
+        knows which client instances may still have writes in flight
+        (the reference journals its session/cap tables)."""
+        self._apply_wcap(etype, client, ino, self._client_iid(client))
+        if self.journal is not None and self.state == "active":
+            try:
+                await self.journal.append(
+                    {"op": etype, "client": client, "ino": ino,
+                     "iid": self._client_iid(client)})
+            except RadosError:
+                pass
+
+    def _apply_wcap(self, etype: str, client: str, ino: int,
+                    iid: str) -> None:
+        if etype == "cap_grant_w":
+            ent = self._wcap_log.setdefault(
+                client, {"iid": iid, "inos": set()})
+            ent["iid"] = iid
+            ent["inos"].add(ino)
+        else:
+            ent = self._wcap_log.get(client)
+            if ent is not None:
+                ent["inos"].discard(ino)
+                if not ent["inos"]:
+                    self._wcap_log.pop(client, None)
+
+    async def _reconnect_and_fence(self) -> None:
+        """Failover reconnect phase: write-cap holders replayed from
+        the journal get a grace window to show up at the NEW active;
+        the silent ones are blocklisted before we serve (a deposed
+        client's delayed writes must not land on data someone else
+        now holds the cap for).  Survivors get their caps RE-SEATED,
+        so a later conflicting open revokes them like any holder."""
+        if not self._wcap_log:
+            return
+        # only contacts DURING the window count: entries from a
+        # previous tenure of this daemon (mds flap) must not spare a
+        # holder that is in fact wedged
+        self._reconnected.clear()
+        deadline = _now() + RECONNECT_GRACE
+        last_renew = _now()
+        while _now() < deadline and \
+                set(self._wcap_log) - self._reconnected:
+            await asyncio.sleep(0.05)
+            if _now() - last_renew > LOCK_RENEW:
+                # the window must not outlive the journal fence or the
+                # mon's beacon grace: a silent wait here would seat a
+                # SECOND active (the split-brain the lock prevents)
+                last_renew = _now()
+                await self._renew_lock()
+                await self._send_beacon()
+        for client, ent in list(self._wcap_log.items()):
+            if client in self._reconnected:
+                # survivor: re-seat its write caps so the next
+                # conflicting open goes through revoke, not a silent
+                # double-grant
+                for ino in ent["inos"]:
+                    self.caps.setdefault(ino, {})[client] = {
+                        "mode": "w", "expires": _now() + CAP_LEASE}
+                continue
+            try:
+                await self.rados.mon_command(
+                    "osd blocklist", {"id": ent["iid"],
+                                      "duration": 600})
+            except Exception:
+                pass
+            self._wcap_log.pop(client, None)
 
     def _renew_session(self, client: str) -> None:
         now = _now()
@@ -473,12 +641,15 @@ class MDS:
     # -- client RPC ----------------------------------------------------------
     async def _dispatch(self, conn, msg: Message) -> None:
         client = msg.from_name
+        self._reconnected.add(client)   # counts toward the failover
+        #                                 reconnect window
         if msg.type == "cap_release":
             ino = msg.data["ino"]
-            self.caps.get(ino, {}).pop(client, None)
-            ev = self._revoke_acks.get((ino, client))
-            if ev is not None:
+            cap = self.caps.get(ino, {}).pop(client, None)
+            for ev in self._revoke_acks.get((ino, client), []):
                 ev.set()
+            if cap is not None and cap["mode"] == "w":
+                await self._journal_wcap("cap_release_w", ino, client)
             return
         if msg.type == "session_renew":
             self._renew_session(client)

@@ -1054,6 +1054,27 @@ class Monitor:
             return await self.services.handle_command(cmd, args)
         except UnknownCommand:
             pass                 # not a service command; fall through
+        if cmd == "osd blocklist":
+            # fence a client INSTANCE ("name:incarnation") at the data
+            # path: every OSD refuses its ops once the map propagates
+            # (OSDMonitor.cc blocklist; fences lease-lapsed cephfs
+            # clients and deposed rbd lock holders)
+            iid = args["id"]
+            inc = Incremental(epoch=0)
+            if args.get("rm"):
+                inc.old_blocklist.append(iid)
+            else:
+                until = time.time() + float(args.get("duration", 3600))
+                inc.new_blocklist[iid] = until
+            inc.service_kv = {"log": self.services.log_entry(
+                "WRN", f"blocklist {'rm ' if args.get('rm') else ''}"
+                       f"{iid}")}
+            await self.propose(inc)
+            return {"id": iid, "epoch": self.osdmap.epoch}
+        if cmd == "osd blocklist ls":
+            now = time.time()
+            return {iid: exp for iid, exp in
+                    self.osdmap.blocklist.items() if exp > now}
         if cmd == "osd pool create":
             return await self._cmd_pool_create(args)
         if cmd == "osd pool rm":
@@ -1285,3 +1306,13 @@ class Monitor:
             for osd in to_out:
                 self._down_since.pop(osd, None)
             await self.propose(inc)
+        # expired blocklist entries leave the map (OSDMonitor::tick
+        # does the same sweep); without it every fence ever made rides
+        # in every full map forever
+        if self.is_leader:
+            expired = [iid for iid, exp in self.osdmap.blocklist.items()
+                       if exp <= time.time()]
+            if expired:
+                inc = Incremental(epoch=0)
+                inc.old_blocklist.extend(expired)
+                await self.propose(inc)

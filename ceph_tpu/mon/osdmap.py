@@ -126,6 +126,13 @@ class Incremental:
     new_hosts: dict[int, str] = field(default_factory=dict)
     # pool_id -> {"snap_seq": int, "removed": [snapids]}
     new_pool_snaps: dict[int, dict] = field(default_factory=dict)
+    # client-instance blocklist (OSDMap::Incremental new_blocklist,
+    # mon/OSDMonitor.cc "osd blocklist"): instance id "name:inc" ->
+    # absolute wall-clock expiry; OSDs refuse ops from listed
+    # instances, fencing lease-lapsed CephFS clients and deposed rbd
+    # lock holders whose delayed writes are still in flight
+    new_blocklist: dict[str, float] = field(default_factory=dict)
+    old_blocklist: list[str] = field(default_factory=list)
     # other PaxosService payloads riding the SAME paxos commit (the
     # reference multiplexes every service over one paxos instance):
     # service -> {key: value-or-None(delete)}; applied by the Monitor's
@@ -173,6 +180,8 @@ class Incremental:
             service_kv=dict(d.get("service_kv", {})),
             new_pool_snaps={int(k): v for k, v in
                             d.get("new_pool_snaps", {}).items()},
+            new_blocklist=dict(d.get("new_blocklist", {})),
+            old_blocklist=list(d.get("old_blocklist", [])),
         )
 
 
@@ -223,6 +232,17 @@ class OSDMap:
         # continuity while the up set backfills)
         self.pg_temp: dict[str, list[int]] = {}
         self.pg_upmap_items: dict[str, list[tuple[int, int]]] = {}
+        # fenced client instances: "name:incarnation" -> expiry (wall
+        # clock).  OSDs refuse ops from these (OSDMap blocklist)
+        self.blocklist: dict[str, float] = {}
+
+    def is_blocklisted(self, instance_id: str,
+                       now: float | None = None) -> bool:
+        import time as _time
+        exp = self.blocklist.get(instance_id)
+        if exp is None:
+            return False
+        return exp > (_time.time() if now is None else now)
 
     # -- queries ------------------------------------------------------------
     def exists(self, osd: int) -> bool:
@@ -364,6 +384,10 @@ class OSDMap:
             for d in (self.pg_temp, self.pg_upmap_items):
                 for pgid in [k for k in d if k.startswith(prefix)]:
                     d.pop(pgid)
+        for iid, exp in inc.new_blocklist.items():
+            self.blocklist[iid] = exp
+        for iid in inc.old_blocklist:
+            self.blocklist.pop(iid, None)
         if inc.new_crush is not None:
             self.crush = crush_from_dict(inc.new_crush)
         for name, profile in inc.new_ec_profiles.items():
@@ -408,6 +432,7 @@ class OSDMap:
             "pg_temp": self.pg_temp,
             "pg_upmap_items": {k: [list(i) for i in v]
                                for k, v in self.pg_upmap_items.items()},
+            "blocklist": dict(self.blocklist),
         }
 
     @classmethod
@@ -427,6 +452,7 @@ class OSDMap:
             m.pools[int(p)] = spec
             m.pool_names[spec.name] = int(p)
         m.crush = crush_from_dict(d["crush"])
+        m.blocklist = dict(d.get("blocklist", {}))
         m.ec_profiles = dict(d.get("ec_profiles", {}))
         m.pg_temp = {k: list(v) for k, v in d.get("pg_temp", {}).items()}
         m.pg_upmap_items = {k: [tuple(i) for i in v]

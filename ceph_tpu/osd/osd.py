@@ -860,6 +860,22 @@ class OSD:
             self.client_throttle.put(nbytes)
 
     async def _do_osd_op(self, conn, msg) -> None:
+        # blocklist fence (OSD.cc session blocklist check): a fenced
+        # instance's delayed/in-flight writes must NOT land -- this is
+        # what makes cap revocation and rbd lock steal safe against a
+        # wedged-but-alive client
+        reqid = msg.data.get("reqid") or [None]
+        iid = reqid[0]
+        # an entry may name a full instance ("client.x:inc") or a bare
+        # entity ("client.x" -- rbd lock break fences every instance)
+        if iid is not None and (
+                self.osdmap.is_blocklisted(str(iid))
+                or self.osdmap.is_blocklisted(
+                    str(iid).split(":", 1)[0])):
+            await conn.send(Message(
+                "osd_op_reply", {"tid": msg.data.get("tid"),
+                                 "err": "EBLOCKLISTED"}))
+            return
         pg = self._get_pg(msg.data["pgid"])
         if pg is None:
             await conn.send(Message(

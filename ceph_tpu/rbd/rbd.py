@@ -381,8 +381,15 @@ class Image:
             await self._renew_once()
 
     @staticmethod
-    async def break_lock(ioctx, name: str) -> None:
-        """Evict a dead client's exclusive lock (rbd lock break)."""
+    async def break_lock(ioctx, name: str,
+                         blocklist: bool = True) -> None:
+        """Evict a dead client's exclusive lock (rbd lock break).
+
+        The deposed holder is BLOCKLISTED at the OSDs first: if it is
+        wedged rather than dead, its delayed writes must not land on
+        an image someone else now owns (rbd lock break pairs with
+        'osd blocklist' exactly like this; ManagedLock.cc
+        break_lock + blacklist)."""
         iid = (await ioctx.exec(RBD_DIRECTORY, "rbd", "dir_get_id",
                                 json.dumps({"name": name}).encode())
                ).decode()
@@ -390,6 +397,10 @@ class Image:
             _header(iid), "lock", "get_info",
             json.dumps({"name": LOCK_NAME}).encode()))
         for lk in info["lockers"]:
+            if blocklist:
+                await ioctx.rados.mon_command(
+                    "osd blocklist", {"id": lk["entity"],
+                                      "duration": 600})
             await ioctx.exec(_header(iid), "lock", "break_lock",
                              json.dumps({"name": LOCK_NAME,
                                          "locker": lk["entity"],

@@ -43,7 +43,7 @@ class FsFile:
         self.dentry = dentry
         self.ino = dentry["ino"]
         lay = dentry.get("layout") or DEFAULT_LAYOUT
-        self.striper = RadosStriper(fs.data, Layout(
+        self.striper = RadosStriper(fs._data_cache or fs.data, Layout(
             stripe_unit=lay["su"], stripe_count=lay["sc"],
             object_size=lay["os"]))
         self.size = dentry.get("size", 0)
@@ -92,6 +92,10 @@ class FsFile:
         self._dirty = True
 
     async def fsync(self) -> None:
+        if self.fs._data_cache is not None:
+            # durability barrier: buffered data lands before the size
+            # update is journaled (a crash can truncate, never corrupt)
+            await self.fs._data_cache.flush()
         if self._dirty:
             await self.fs._request({"op": "setattr", "path": self.path,
                                     "attrs": {"size": self.size}})
@@ -115,7 +119,12 @@ class CephFS:
     def __init__(self, mon_addr: tuple[str, int],
                  meta_pool: str = "cephfs_metadata",
                  data_pool: str = "cephfs_data",
-                 name: str | None = None) -> None:
+                 name: str | None = None,
+                 cache: bool = False) -> None:
+        # write-back data cache (ObjectCacher): file writes ack from
+        # cache; fsync/close/cap-revoke are the flush barriers
+        self._cache_enabled = cache
+        self._data_cache = None
         self.mon_addr = tuple(mon_addr)
         self.meta_pool = meta_pool
         self.data_pool = data_pool
@@ -138,6 +147,9 @@ class CephFS:
         self.meta = await self.rados.open_ioctx(self.meta_pool)
         self.data = await self.rados.open_ioctx(self.data_pool)
         self.rados.objecter.msgr.add_dispatcher(self._on_reply)
+        if self._cache_enabled:
+            from ..client.object_cacher import CachingIoCtx
+            self._data_cache = CachingIoCtx(self.data)
         await self._find_mds()
         # session heartbeat for the MOUNT's lifetime, not just while
         # files are open: an MDS successor fences write-cap holders
@@ -152,6 +164,10 @@ class CephFS:
     async def unmount(self) -> None:
         if self._renew_task:
             self._renew_task.cancel()
+        if self._data_cache is not None:
+            # the final flush failing means acked writes did NOT land:
+            # surface it (the mount is still usable for a retry)
+            await self._data_cache.cacher.close()
         await self.rados.shutdown()
 
     # -- capability bookkeeping ---------------------------------------------
@@ -224,6 +240,13 @@ class CephFS:
                 pass
             f._stale = True
             f.caps = ""
+        if self._data_cache is not None:
+            # the cap is leaving us: another client may write next, so
+            # our CLEAN extents are about to go stale (cap coherence)
+            try:
+                await self._data_cache.cacher.invalidate()
+            except Exception:
+                pass
         try:
             await self._send_to_mds(Message("cap_release",
                                             {"ino": ino}))

@@ -188,6 +188,8 @@ class Image:
         self._parent: Image | None = None
         self._closed = False
         self._fenced = False
+        # write-back cache (ObjectCacher), bound at open(cache=True)
+        self.cacher = None
         # feature handles (object-map / journaling), bound at open
         from .features import (FEATURE_JOURNALING, FEATURE_OBJECT_MAP,
                                ImageJournal, ObjectMap)
@@ -201,12 +203,19 @@ class Image:
     @staticmethod
     async def open(ioctx, name: str, snapshot: str | None = None,
                    read_only: bool = False,
-                   exclusive: bool = True) -> "Image":
+                   exclusive: bool = True,
+                   cache: bool = False) -> "Image":
         """``exclusive=False`` opens writable WITHOUT taking the image
         lock -- for snapshot-only administrative handles (rbd-mirror
         snapshots a live image without stealing the client's lock; the
         header mutations are atomic cls ops).  Data writes through a
-        non-exclusive handle forgo single-writer protection."""
+        non-exclusive handle forgo single-writer protection.
+
+        ``cache=True`` puts an ObjectCacher under the data path
+        (rbd_cache): writes ack from cache and flush in the
+        background or at barriers (flush/close/snap/lock-loss); safe
+        only under the exclusive lock, which guarantees the single
+        writer the cache assumes."""
         try:
             iid = (await ioctx.exec(
                 RBD_DIRECTORY, "rbd", "dir_get_id",
@@ -240,11 +249,19 @@ class Image:
             # COW that keeps the new snapshot frozen
             img._watch_cookie = await img.ioctx.watch(
                 _header(img.id), img._on_header_notify)
+            if cache:
+                from ..client.object_cacher import ObjectCacher
+                img.cacher = ObjectCacher(img.ioctx)
         await img._refresh_snapc()
         return img
 
     async def _on_header_notify(self, payload: bytes) -> None:
         try:
+            if self.cacher is not None:
+                # another client changed the header (snap/resize): our
+                # buffered writes must land under the OLD snapc before
+                # we refresh, and cached cleans may be stale
+                await self.cacher.invalidate()
             await self._refresh_meta()
             await self._refresh_snapc()
         except RadosError:
@@ -259,9 +276,29 @@ class Image:
         except RadosError:
             pass                   # no watchers / transient: best effort
 
+    async def flush(self) -> None:
+        """Durability barrier (rbd_flush): buffered writes are at the
+        OSDs on return."""
+        if self.cacher is not None:
+            await self.cacher.flush()
+
     async def close(self) -> None:
         if self._closed:
             return
+        flush_err = None
+        if self.cacher is not None:
+            if self._fenced:
+                # a fenced handle's dirty data must DIE: the new lock
+                # owner's view wins, and our writes would be refused
+                # at the OSDs anyway
+                self.cacher.discard_all()
+            try:
+                await self.cacher.close()
+            except BaseException as e:
+                # the final flush failed: STILL tear down (lock, watch,
+                # renew task -- leaking them blocks other clients), but
+                # surface the data loss to the caller
+                flush_err = e
         self._closed = True
         if self._renew_task:
             self._renew_task.cancel()
@@ -286,6 +323,10 @@ class Image:
         if self._parent is not None:
             await self._parent.close()
             self._parent = None
+        if flush_err is not None:
+            # teardown completed, but the final flush did not land:
+            # the caller must know its last writes may be lost
+            raise flush_err
 
     # -- exclusive lock (ManagedLock / cls_lock) ----------------------------
     async def _acquire_lock(self) -> None:
@@ -371,6 +412,9 @@ class Image:
             # ManagedLock.cc / image_watcher).
             if e.errno_name in ("EBUSY", "ENOENT"):
                 self._fenced = True
+                if self.cacher is not None:
+                    # lock lost: buffered writes must not land late
+                    self.cacher.discard_all()
             # other errors (transient): retried next period
         except (ConnectionError, OSError):
             pass                      # retried next period; expiry wins
@@ -495,6 +539,28 @@ class Image:
         extents = map_extents(lay, off, length)
 
         async def read_one(idx, objectno, obj_off, n):
+            if self.cacher is not None and self.snap_id is None:
+                logical0 = logical[idx]
+
+                async def miss(o, ln):
+                    # miss path inside the cacher: object read with
+                    # hole -> parent/zero fallback (clone reads)
+                    try:
+                        got = await self.ioctx.read(
+                            self._data_obj(objectno), length=ln,
+                            offset=o)
+                        return got
+                    except RadosError as e:
+                        if e.errno_name != "ENOENT":
+                            raise
+                    if self.meta.get("parent"):
+                        return await self._read_parent(
+                            logical0 + (o - obj_off), ln)
+                    return b"\0" * ln
+
+                buf = await self.cacher.read(
+                    self._data_obj(objectno), obj_off, n, reader=miss)
+                return idx, buf, False
             try:
                 buf = await self.ioctx.read(
                     self._data_obj(objectno), length=n, offset=obj_off,
@@ -568,8 +634,12 @@ class Image:
                         await self._copyup(objectno)
                     else:
                         raise
-            await self.ioctx.write(self._data_obj(objectno), piece,
-                                   offset=obj_off)
+            if self.cacher is not None:
+                await self.cacher.write(self._data_obj(objectno),
+                                        obj_off, piece)
+            else:
+                await self.ioctx.write(self._data_obj(objectno),
+                                       piece, offset=obj_off)
 
         jobs = []
         pos = 0
@@ -589,6 +659,14 @@ class Image:
         """Deallocate a range: whole objects are removed, partial
         ranges zeroed (ImageRequest discard)."""
         self._writable_or_raise()
+        if self.cacher is not None:
+            # buffered writes ordered BEFORE the discard must land
+            # first; cached extents in the range are then stale (the
+            # flusher must never resurrect a discarded object)
+            await self.cacher.flush()
+            lay0 = self._layout
+            for objectno, _, _ in map_extents(lay0, off, length):
+                self.cacher.discard(self._data_obj(objectno))
         lay = self._layout
         has_parent = bool(self.meta.get("parent"))
         jseq = None
@@ -637,6 +715,14 @@ class Image:
     # -- resize -------------------------------------------------------------
     async def resize(self, new_size: int) -> None:
         self._writable_or_raise()
+        if self.cacher is not None and new_size < self.meta["size"]:
+            # flush buffered writes, then drop cached state for every
+            # object past the new boundary (and the boundary object:
+            # its cached tail is gone)
+            await self.cacher.flush()
+            for i in range(max(0, self._object_count(new_size) - 1),
+                           self._object_count(self.meta["size"])):
+                self.cacher.discard(self._data_obj(i))
         jseq = None
         if self.journal is not None:
             jseq = await self.journal.append(
@@ -669,6 +755,10 @@ class Image:
     # -- snapshots -----------------------------------------------------------
     async def create_snap(self, snap_name: str) -> int:
         self._writable_or_raise()
+        if self.cacher is not None:
+            # the snapshot must contain every write acked before it:
+            # cached dirty data lands under the PRE-snap snapc first
+            await self.cacher.flush()
         jseq = None
         if self.journal is not None:
             jseq = await self.journal.append(

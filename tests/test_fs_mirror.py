@@ -88,11 +88,17 @@ def test_fs_mirror_daemon_configured_dirs():
             # the loop picks up later writes
             daemon.start()
             await fsa.write_file("/shared/new", b"late arrival")
+            # wait for CONTENT, not mere existence: a sync cycle can
+            # catch the source between dentry creation and the size
+            # flush; a later cycle completes the copy
+            got = b""
             for _ in range(40):
                 await asyncio.sleep(0.25)
                 if await fsb.exists("/shared/new"):
-                    break
-            assert await fsb.read_file("/shared/new") == b"late arrival"
+                    got = await fsb.read_file("/shared/new")
+                    if got:
+                        break
+            assert got == b"late arrival"
             await daemon.stop()
             await fs_mirror_remove(fsa.meta, "/shared")
             assert await fs_mirror_dirs(fsa.meta) == []

@@ -106,8 +106,13 @@ class RadosStriper:
                     str(off + len(data)).encode())
 
     async def read(self, soid: str, length: int | None = None,
-                   off: int = 0) -> bytes:
-        size = await self.size(soid)
+                   off: int = 0, snap: int | None = None,
+                   size_override: int | None = None) -> bytes:
+        """``snap``/``size_override``: read a SNAPSHOT view -- data at
+        the snap id, bounded by the frozen size (the head's size xattr
+        moved on)."""
+        size = (size_override if size_override is not None
+                else await self.size(soid))
         if off >= size:
             return b""
         length = size - off if length is None else min(length,
@@ -118,7 +123,8 @@ class RadosStriper:
             from .rados import RadosError
             try:
                 buf = await self.ioctx.read(self._obj(soid, objectno),
-                                            length=n, offset=obj_off)
+                                            length=n, offset=obj_off,
+                                            snap=snap)
             except RadosError as e:
                 if e.errno_name != "ENOENT":
                     raise             # timeouts etc. must surface,

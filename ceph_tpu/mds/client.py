@@ -37,15 +37,32 @@ class FsFile:
     (Locker.cc cap revocation compressed to the Fr/Fw pair)."""
 
     def __init__(self, fs: "CephFS", path: str, dentry: dict,
-                 append: bool = False, caps: str = "r") -> None:
+                 append: bool = False, caps: str = "r",
+                 snap_id: int | None = None,
+                 snapc: dict | None = None) -> None:
         self.fs = fs
         self.path = path
         self.dentry = dentry
         self.ino = dentry["ino"]
+        self.snap_id = snap_id          # frozen .snap view when set
         lay = dentry.get("layout") or DEFAULT_LAYOUT
-        self.striper = RadosStriper(fs._data_cache or fs.data, Layout(
-            stripe_unit=lay["su"], stripe_count=lay["sc"],
-            object_size=lay["os"]))
+        layout = Layout(stripe_unit=lay["su"], stripe_count=lay["sc"],
+                        object_size=lay["os"])
+        if snapc is not None:
+            # a snapped realm: writes must stamp the realm's snapc so
+            # the OSDs COW pre-snap data.  The snapc is per-file, so
+            # the handle gets a PRIVATE ioctx (a shared one would leak
+            # this context onto other files' writes) and bypasses the
+            # shared write-back cache
+            from ..client.rados import IoCtx
+            dio = IoCtx(fs.rados, fs.data.pool_name, fs.data.pool_id)
+            dio.set_snap_context(snapc["seq"], snapc["snaps"])
+            self.striper = RadosStriper(dio, layout)
+        elif snap_id is not None:
+            self.striper = RadosStriper(fs.data, layout)
+        else:
+            self.striper = RadosStriper(fs._data_cache or fs.data,
+                                        layout)
         self.size = dentry.get("size", 0)
         self.caps = caps
         self._stale = False
@@ -62,10 +79,26 @@ class FsFile:
         self.dentry = out["dentry"]
         self.size = self.dentry.get("size", 0)
         self.caps = out.get("caps", want)
+        snapc = out.get("snapc")
+        if snapc is not None:
+            # the realm was snapped while we were revoked: subsequent
+            # writes MUST stamp the new snapc or they overwrite data
+            # the snapshot froze.  Rebuild the data path with it (a
+            # private ioctx -- the shared one must not inherit it)
+            from ..client.rados import IoCtx
+            lay = self.dentry.get("layout") or DEFAULT_LAYOUT
+            dio = IoCtx(self.fs.rados, self.fs.data.pool_name,
+                        self.fs.data.pool_id)
+            dio.set_snap_context(snapc["seq"], snapc["snaps"])
+            self.striper = RadosStriper(dio, Layout(
+                stripe_unit=lay["su"], stripe_count=lay["sc"],
+                object_size=lay["os"]))
         self._stale = False
         self.fs._note_lease()
 
     async def write(self, data: bytes, offset: int | None = None) -> int:
+        if self.snap_id is not None:
+            raise FsError("EROFS", "snapshot view is read-only")
         if self._stale or "w" not in self.caps \
                 or not self.fs._caps_fresh():
             await self._reacquire("w")
@@ -79,6 +112,12 @@ class FsFile:
 
     async def read(self, length: int | None = None,
                    offset: int = 0) -> bytes:
+        if self.snap_id is not None:
+            # frozen view: data at the snap id, size from the frozen
+            # dentry (the head's size xattr has moved on)
+            return await self.striper.read(
+                f"{self.ino:x}", length, offset, snap=self.snap_id,
+                size_override=self.dentry.get("size", 0))
         if self._stale:
             await self._reacquire("r" if "w" not in self.caps else "w")
         return await self.striper.read(f"{self.ino:x}", length, offset)
@@ -104,6 +143,9 @@ class FsFile:
     async def close(self) -> None:
         if not self._closed:
             self._closed = True
+            if self.snap_id is not None:
+                self.fs._untrack_file(self)
+                return                 # frozen view: nothing to flush
             await self.fsync()
             self.fs._untrack_file(self)
             try:
@@ -364,6 +406,22 @@ class CephFS:
     async def rename(self, src: str, dst: str) -> None:
         await self._request({"op": "rename", "path": src, "dst": dst})
 
+    # -- snapshots ----------------------------------------------------------
+    async def mksnap(self, path: str, name: str) -> int:
+        """Snapshot a directory subtree (mkdir <path>/.snap/<name>);
+        read the frozen view back via '<path>/.snap/<name>/...'."""
+        out = await self._request({"op": "mksnap", "path": path,
+                                   "name": name})
+        return out["snapid"]
+
+    async def rmsnap(self, path: str, name: str) -> None:
+        await self._request({"op": "rmsnap", "path": path,
+                             "name": name})
+
+    async def lssnap(self, path: str) -> dict:
+        return (await self._request({"op": "lssnap",
+                                     "path": path}))["snaps"]
+
     async def open(self, path: str, flags: str = "r",
                    mode: int = 0o644) -> FsFile:
         create = "w" in flags or "a" in flags or "+" in flags
@@ -373,7 +431,9 @@ class CephFS:
                                    "want": want})
         self._note_lease()
         f = FsFile(self, path, out["dentry"], append="a" in flags,
-                   caps=out.get("caps", want))
+                   caps=out.get("caps", want),
+                   snap_id=out.get("snapid"),
+                   snapc=out.get("snapc"))
         if "w" in flags:        # 'w' and 'w+' both truncate (fopen(3))
             await f.truncate(0)
         return f

@@ -26,8 +26,14 @@ A compressed rendering of src/mds:
     single "w" holder may write data and buffer size updates.  A
     conflicting open REVOKES: holders flush dirty state and release;
     a dead client's caps lapse with its lease so revocation cannot
-    hang.  Data-path fencing of a revoked-but-alive client across MDS
-    failover (the OSD blocklist) is out of scope and noted here.
+    hang; a revoked-but-alive client that never acks is FENCED at the
+    data path via the OSDMap blocklist, and failover runs a
+    reconnect-or-fence window over journaled write-cap custody.
+  * Directory snapshots (SnapServer/snaprealm compressed): a subtree
+    freeze captured as a manifest + pool self-managed snap id;
+    ".snap/<name>" paths resolve the frozen view; writers under a
+    snapped realm stamp the realm snapc so OSDs COW; rmsnap feeds the
+    OSD snap-trim machinery.
   * unlink purges file data through the striper after the journal
     commits (PurgeQueue analog).
 """
@@ -45,6 +51,7 @@ from .journal import Journal
 
 ROOT_INO = 1
 MDSMAP_OID = "mds_map"
+SNAPDIRS_OID = "mds_snapdirs"
 INOTABLE_OID = "mds_inotable"
 LOCK_NAME = "mds_active"
 LOCK_DURATION = 6.0
@@ -108,6 +115,15 @@ class MDS:
         # session-table blocklist, mds/Server.cc reconnect)
         self._wcap_log: dict[str, dict] = {}
         self._reconnected: set[str] = set()
+        # dirs that have snapshots (ino set, persisted in SNAPDIRS_OID
+        # omap): lets the open hot path skip realm-snapc computation
+        # entirely when the filesystem has no snapshots
+        self._snapped_dirs: set[int] = set()
+        self._snap_ids: set[int] = set()
+        # serializes mksnap's revoke->allocate->freeze sequence against
+        # write-cap grants: an open racing that window would get a
+        # snapc without the new id and overwrite frozen data
+        self._snap_barrier = asyncio.Lock()
         self.mon_addr: tuple[str, int] | None = None
         self.msgr.add_dispatcher(self._dispatch)
 
@@ -258,6 +274,13 @@ class MDS:
                 last_renew = loop.time()
         await self.journal.trim()
         await self._load_inotable()
+        try:
+            snapdirs = await self.meta.get_omap(SNAPDIRS_OID)
+        except RadosError:
+            snapdirs = {}
+        self._snap_ids = {int(k) for k in snapdirs}
+        self._snapped_dirs = {json.loads(v)["dir"]
+                              for v in snapdirs.values()}
         # ensure the root dirfrag exists
         try:
             await self.meta.stat(dir_oid(ROOT_INO))
@@ -303,7 +326,10 @@ class MDS:
             omap = await self.meta.get_omap(dir_oid(ino))
         except RadosError:
             return {}
-        return {k: json.loads(v) for k, v in omap.items()}
+        # "snap:*" keys are the directory's snapshot table (snaprealm
+        # sidecar), not dentries
+        return {k: json.loads(v) for k, v in omap.items()
+                if not k.startswith("snap:")}
 
     async def _lookup_dentry(self, ino: int, name: str) -> dict | None:
         d = await self._dentries(ino)
@@ -324,11 +350,102 @@ class MDS:
             chain.append(ino)
         return chain
 
+    # -- snapshots (SnapServer / snaprealms compressed) ----------------------
+    #
+    # A directory snapshot (mkdir .snap/<name> in the reference,
+    # src/mds/SnapServer.h + doc/dev/cephfs-snapshots.rst) freezes the
+    # SUBTREE: the namespace is captured as a manifest object written
+    # at snap time (relpath -> dentry, sizes post cap-flush), and file
+    # DATA rides the pool's self-managed snap machinery -- writers
+    # under a snapped realm stamp a snapc that makes the OSDs COW, and
+    # ".snap/<name>/..." reads resolve through the manifest and read
+    # data objects at the snap id.  rmsnap releases the pool snap id,
+    # which the existing OSD snap-trim reclaims.
+
+    def _snap_manifest_oid(self, ino: int, sid: int) -> str:
+        return f"snapmanifest.{ino:x}.{sid}"
+
+    async def _snap_table(self, ino: int) -> dict[str, int]:
+        try:
+            omap = await self.meta.get_omap(dir_oid(ino))
+        except RadosError:
+            return {}
+        return {k[len("snap:"):]: json.loads(v)["id"]
+                for k, v in omap.items() if k.startswith("snap:")}
+
+    async def _subtree_walk(self, ino: int,
+                            prefix: str = "") -> dict[str, dict]:
+        """relpath -> dentry for everything under a directory."""
+        out: dict[str, dict] = {}
+        for name, dent in (await self._dentries(ino)).items():
+            rel = f"{prefix}{name}"
+            out[rel] = dent
+            if dent.get("type") == "dir":
+                out.update(await self._subtree_walk(dent["ino"],
+                                                    rel + "/"))
+        return out
+
+    async def _realm_snapc(self, path: str) -> dict | None:
+        """The snap context writes under ``path`` must stamp.
+
+        CONSERVATIVE: every live snapshot id in the filesystem (the
+        in-memory registry loaded at activation).  Precise per-realm
+        sets would need parent pointers the dirfrag schema does not
+        keep; the superset only costs spurious COW clones on files
+        outside the realm, which trim with the snap -- while being
+        O(1) on the open hot path and correct across MDS restarts
+        (it never depends on accumulated ioctx state)."""
+        if not self._snap_ids:
+            return None
+        snaps = sorted(self._snap_ids, reverse=True)
+        return {"seq": snaps[0], "snaps": snaps}
+
+    async def _resolve_snap(self, parts: list[str], i: int):
+        """Handle a '.snap' path component: parts[i] == '.snap' under
+        the directory chain parts[:i].  Returns (dentry, sid) of the
+        frozen view -- or raises."""
+        dir_ino, dir_dent = await self._resolve(
+            "/".join(parts[:i]) or "/")
+        if dir_dent["type"] != "dir":
+            raise FsOpError("ENOTDIR", "/".join(parts[:i]))
+        table = await self._snap_table(dir_ino)
+        if i + 1 >= len(parts):
+            # ".snap" itself: a pseudo-dir listing snapshot names
+            return ({"ino": dir_ino, "type": "snapdir",
+                     "snaps": sorted(table)}, None)
+        snapname = parts[i + 1]
+        sid = table.get(snapname)
+        if sid is None:
+            raise FsOpError("ENOENT", f".snap/{snapname}")
+        try:
+            raw = await self.meta.read(
+                self._snap_manifest_oid(dir_ino, sid))
+        except RadosError:
+            # table entry journaled but manifest gone/in-flight
+            raise FsOpError("EAGAIN", f".snap/{snapname} not ready")
+        manifest = json.loads(raw)["dentries"]
+        rest = parts[i + 2:]
+        if not rest:
+            dent = {"ino": dir_ino, "type": "dir", "mode": 0o755}
+        else:
+            dent = manifest.get("/".join(rest))
+            if dent is None:
+                raise FsOpError("ENOENT", "/".join(parts))
+        return ({**dent, "snapid": sid, "manifest_dir": dir_ino,
+                 "_manifest": manifest}, sid)
+
     async def _resolve(self, path: str,
                        want_parent: bool = False):
         """Walk the path from root. Returns (ino, dentry|None) or, with
         want_parent, (parent_ino, leaf_name, dentry|None)."""
         parts = [p for p in path.split("/") if p]
+        if ".snap" in parts:
+            if want_parent:
+                raise FsOpError("EROFS", "snapshots are read-only")
+            dent, _sid = await self._resolve_snap(
+                parts, parts.index(".snap"))
+            dent = {k: v for k, v in dent.items() if k != "_manifest"}
+            return dent["ino"], dent
         ino = ROOT_INO
         dent = {"ino": ROOT_INO, "type": "dir", "mode": 0o755}
         for i, name in enumerate(parts):
@@ -383,6 +500,27 @@ class MDS:
             # successor knows whom to reconnect-or-fence
             self._apply_wcap(op, ev["client"], ev["ino"], ev["iid"])
             return
+        if op == "mksnap":
+            await self.meta.set_omap(dir_oid(ev["dir"]), {
+                f"snap:{ev['name']}": json.dumps(
+                    {"id": ev["sid"]}).encode()})
+            await self.meta.set_omap(SNAPDIRS_OID, {
+                str(ev["sid"]): json.dumps(
+                    {"dir": ev["dir"],
+                     "name": ev["name"]}).encode()})
+            self._snap_ids.add(ev["sid"])
+            self._snapped_dirs.add(ev["dir"])
+            return
+        if op == "rmsnap":
+            try:
+                await self.meta.rm_omap_keys(
+                    dir_oid(ev["dir"]), [f"snap:{ev['name']}"])
+                await self.meta.rm_omap_keys(SNAPDIRS_OID,
+                                             [str(ev["sid"])])
+            except RadosError:
+                pass
+            self._snap_ids.discard(ev["sid"])
+            return
         if op == "link":
             await self.meta.set_omap(dir_oid(ev["dir"]), {
                 ev["name"]: json.dumps(ev["dentry"]).encode()})
@@ -436,9 +574,21 @@ class MDS:
                     ev["name"]: json.dumps(dent).encode()})
 
     # -- purge (PurgeQueue) --------------------------------------------------
-    async def _purge_file(self, dent: dict) -> None:
+    async def _purge_file(self, dent: dict,
+                          path: str = "/") -> None:
         lay = dent.get("layout", DEFAULT_LAYOUT)
-        striper = RadosStriper(self.data, Layout(
+        dio = self.data
+        snapc = await self._realm_snapc(path)
+        if snapc is not None:
+            # the remove must stamp the realm's snapc so the OSD COWs
+            # the head into the snap clones instead of deleting the
+            # only copy -- and it must not depend on whatever snapc
+            # happens to be folded into self.data (an MDS restart
+            # starts with a clean ioctx while the realm persists)
+            dio = IoCtx(self.rados, self.data.pool_name,
+                        self.data.pool_id)
+            dio.set_snap_context(snapc["seq"], snapc["snaps"])
+        striper = RadosStriper(dio, Layout(
             stripe_unit=lay["su"], stripe_count=lay["sc"],
             object_size=lay["os"]))
         try:
@@ -679,6 +829,15 @@ class MDS:
     async def _handle(self, q: dict, client: str = "") -> dict:
         op = q["op"]
         path = q.get("path", "/")
+        leaf = path.rstrip("/").rsplit("/", 1)[-1]
+        if op in ("mkdir", "create", "open", "rename") and (
+                leaf.startswith("snap:")
+                or (op == "rename" and str(q.get("dst", ""))
+                    .rstrip("/").rsplit("/", 1)[-1]
+                    .startswith("snap:"))):
+            # "snap:*" omap keys are the snaprealm table; a dentry with
+            # that name would shadow it
+            raise FsOpError("EINVAL", "'snap:' names are reserved")
         if op in ("mkdir", "create", "unlink", "rmdir", "rename",
                   "setattr"):
             async with self._lock:
@@ -695,6 +854,23 @@ class MDS:
             _, dent = await self._resolve(path)
             return {"dentry": dent}
         if op == "readdir":
+            parts = [p for p in path.split("/") if p]
+            if ".snap" in parts:
+                i = parts.index(".snap")
+                dent, sid = await self._resolve_snap(parts, i)
+                if dent.get("type") == "snapdir":
+                    return {"entries": {
+                        n: {"type": "dir", "ino": dent["ino"]}
+                        for n in dent["snaps"]}}
+                if dent.get("type") != "dir":
+                    raise FsOpError("ENOTDIR", path)
+                manifest = dent["_manifest"]
+                rel = "/".join(parts[i + 2:])
+                pref = rel + "/" if rel else ""
+                return {"entries": {
+                    k[len(pref):]: v for k, v in manifest.items()
+                    if k.startswith(pref)
+                    and "/" not in k[len(pref):]}}
             if path.strip("/") == "":
                 ino = ROOT_INO
             else:
@@ -702,8 +878,44 @@ class MDS:
                 if dent["type"] != "dir":
                     raise FsOpError("ENOTDIR", path)
             return {"entries": await self._dentries(ino)}
+        if op == "mksnap":
+            # NOT under the mutation lock: revocation waits for the
+            # holders' flushes, which are themselves locked mutations
+            # (same reason open's cap grant sits outside the lock).
+            # reqid dedup: a resend of a slow mksnap (revokes can take
+            # a full lease) must ack, not re-execute into EEXIST
+            reqid = q.get("reqid")
+            if reqid and reqid in self._completed:
+                return dict(self._completed[reqid])
+            out = await self._handle_mksnap(path, q["name"])
+            if reqid:
+                self._remember(reqid, out)
+            return out
+        if op == "rmsnap":
+            reqid = q.get("reqid")
+            if reqid and reqid in self._completed:
+                return dict(self._completed[reqid])
+            out = await self._handle_rmsnap(path, q["name"])
+            if reqid:
+                self._remember(reqid, out)
+            return out
+        if op == "lssnap":
+            ino, dent = await self._resolve(path)
+            if dent["type"] != "dir":
+                raise FsOpError("ENOTDIR", path)
+            return {"snaps": await self._snap_table(ino)}
         if op == "open":
             want = q.get("want", "r")
+            if ".snap" in path.split("/"):
+                if want != "r":
+                    raise FsOpError("EROFS", "snapshots are read-only")
+                _ino, dent = await self._resolve(path)
+                if dent.get("type") == "dir" \
+                        or dent.get("type") == "snapdir":
+                    raise FsOpError("EISDIR", path)
+                return {"dentry": dent, "caps": "r",
+                        "snapid": dent.get("snapid"),
+                        "lease_s": CAP_LEASE}
             parent, name, dent = await self._resolve(path,
                                                      want_parent=True)
             if dent is None:
@@ -717,9 +929,19 @@ class MDS:
                 out = {"dentry": dent, "parent": parent, "name": name}
             # cap grant OUTSIDE the mutation lock: the revoked client's
             # flush is itself a locked mutation (setattr) and must be
-            # able to land while we wait for its release
-            granted = await self._acquire_caps(
-                out["dentry"]["ino"], client, want)
+            # able to land while we wait for its release.  Write
+            # grants serialize with mksnap's freeze window (see
+            # _snap_barrier) so the snapc handed out always includes
+            # any snapshot being taken right now
+            if want == "w":
+                async with self._snap_barrier:
+                    granted = await self._acquire_caps(
+                        out["dentry"]["ino"], client, want)
+                    snapc = await self._realm_snapc(path)
+            else:
+                granted = await self._acquire_caps(
+                    out["dentry"]["ino"], client, want)
+                snapc = await self._realm_snapc(path)
             # re-read: the flush may have grown the size we hand out
             parent2, name2, dent2 = await self._resolve(
                 path, want_parent=True)
@@ -727,8 +949,74 @@ class MDS:
                 out["dentry"] = dent2
             out["caps"] = granted
             out["lease_s"] = CAP_LEASE
+            if snapc is not None:
+                # writes under a snapped realm must stamp this snapc
+                # so the OSDs COW pre-snap data (snaprealm -> client
+                # cap message carries the context in the reference)
+                out["snapc"] = snapc
             return out
         raise FsOpError("EOPNOTSUPP", op)
+
+    async def _handle_mksnap(self, path: str, name: str) -> dict:
+        """mkdir .snap/<name>: freeze the subtree.  Write caps under
+        it are revoked first (holders flush), the data pool allocates
+        the snap id, and the post-flush namespace is captured as the
+        manifest (SnapServer::prepare + the snaprealm split,
+        compressed)."""
+        ino, dent = await self._resolve(path)
+        if dent["type"] != "dir":
+            raise FsOpError("ENOTDIR", path)
+        if name in await self._snap_table(ino):
+            raise FsOpError("EEXIST", f".snap/{name}")
+        # the barrier fences write-cap GRANTS for the whole
+        # revoke->allocate->freeze sequence: an open slipping between
+        # the revokes and the journaled table entry would write with a
+        # snapc that lacks the new id, overwriting frozen data
+        async with self._snap_barrier:
+            subtree = await self._subtree_walk(ino)
+            for rel, d in subtree.items():
+                if d.get("type") != "dir":
+                    holders = list(self.caps.get(d["ino"], {}))
+                    for client in holders:
+                        await self._revoke_cap(d["ino"], client)
+            sid = await self.data.selfmanaged_snap_create()
+            subtree = await self._subtree_walk(ino)  # post-flush sizes
+            await self.meta.write_full(
+                self._snap_manifest_oid(ino, sid),
+                json.dumps({"dentries": subtree}).encode())
+            async with self._lock:
+                if name in await self._snap_table(ino):
+                    # lost a race: release everything this attempt
+                    # allocated (snap id, manifest) before failing
+                    try:
+                        await self.meta.remove(
+                            self._snap_manifest_oid(ino, sid))
+                    except RadosError:
+                        pass
+                    await self.data.selfmanaged_snap_remove(sid)
+                    raise FsOpError("EEXIST", f".snap/{name}")
+                await self._journal_and_apply(
+                    {"op": "mksnap", "dir": ino,
+                     "name": name, "sid": sid})
+        return {"snapid": sid}
+
+    async def _handle_rmsnap(self, path: str, name: str) -> dict:
+        ino, dent = await self._resolve(path)
+        table = await self._snap_table(ino)
+        sid = table.get(name)
+        if sid is None:
+            raise FsOpError("ENOENT", f".snap/{name}")
+        async with self._lock:
+            await self._journal_and_apply({"op": "rmsnap", "dir": ino,
+                                           "name": name, "sid": sid})
+        try:
+            await self.meta.remove(self._snap_manifest_oid(ino, sid))
+        except RadosError:
+            pass
+        # release the pool snap id: the OSDs' snap-trim machinery
+        # reclaims the clones (pg_pool_t removed_snaps path)
+        await self.data.selfmanaged_snap_remove(sid)
+        return {"snapid": sid}
 
     async def _handle_mutation(self, op: str, path: str,
                                q: dict) -> dict:

@@ -251,6 +251,58 @@ class Gateway:
         if req.method == "DELETE" and "lifecycle" in q:
             await self.store.delete_bucket_lifecycle(bucket)
             return 204, {}, b""
+        if req.method == "PUT" and "notification" in q:
+            # S3 PutBucketNotificationConfiguration: Topic + Event
+            # elements per TopicConfiguration (rgw_rest_pubsub)
+            root = ET.fromstring(req.body)
+            ns = root.tag.partition("}")[0] + "}" \
+                if root.tag.startswith("{") else ""
+            configs = []
+            for tc in root.findall(f"{ns}TopicConfiguration"):
+                cfg = {"id": tc.findtext(f"{ns}Id") or "",
+                       "topic": (tc.findtext(f"{ns}Topic") or ""
+                                 ).rsplit(":", 1)[-1],
+                       "events": [e.text for e in
+                                  tc.findall(f"{ns}Event")
+                                  if e.text]}
+                fr = tc.find(f"{ns}Filter")
+                if fr is not None:
+                    filt = {}
+                    for rule in fr.iter(f"{ns}FilterRule"):
+                        n = rule.findtext(f"{ns}Name") or ""
+                        v = rule.findtext(f"{ns}Value") or ""
+                        filt[n.lower()] = v
+                    cfg["filter"] = filt
+                configs.append(cfg)
+            await self.store.notify.put_bucket_notification(
+                bucket, configs)
+            return 200, {}, b""
+        if req.method == "GET" and "notification" in q:
+            configs = await self.store.notify.get_bucket_notification(
+                bucket)
+            from xml.sax.saxutils import escape
+            parts = []
+            for c in configs:
+                evs = "".join(f"<Event>{escape(e)}</Event>"
+                              for e in c.get("events", []))
+                filt = ""
+                rules = "".join(
+                    f"<FilterRule><Name>{escape(n)}</Name>"
+                    f"<Value>{escape(v)}</Value></FilterRule>"
+                    for n, v in (c.get("filter") or {}).items())
+                if rules:
+                    filt = (f"<Filter><S3Key>{rules}</S3Key>"
+                            f"</Filter>")
+                parts.append(
+                    f"<TopicConfiguration>"
+                    f"<Id>{escape(c.get('id', ''))}</Id>"
+                    f"<Topic>{escape(c['topic'])}</Topic>{evs}{filt}"
+                    f"</TopicConfiguration>")
+            return 200, {"content-type": "application/xml"}, (
+                f'<?xml version="1.0"?>'
+                f'<NotificationConfiguration xmlns="{XMLNS}">'
+                f"{''.join(parts)}</NotificationConfiguration>"
+            ).encode()
         if req.method == "GET" and "versions" in q:
             return await self._list_versions(req, bucket)
         if req.method == "PUT":

@@ -51,10 +51,12 @@ def _now_iso() -> str:
 
 class RgwStore:
     def __init__(self, ioctx, stripe_unit: int = 1 << 22) -> None:
+        from .notify import NotificationManager
         self.ioctx = ioctx
         self.striper = RadosStriper(
             ioctx, Layout(stripe_unit=stripe_unit,
                           object_size=stripe_unit))
+        self.notify = NotificationManager(self)
 
     # -- users (RGWUserCtl / radosgw-admin user create) ---------------------
     async def create_user(self, uid: str, display_name: str,
@@ -177,6 +179,14 @@ class RgwStore:
     async def get_bucket_versioning(self, name: str) -> str:
         return (await self.get_bucket(name)).get("versioning", "")
 
+    async def _save_bucket(self, bucket: dict) -> None:
+        """Patch mutable bucket metadata (notifications etc.)."""
+        await self.ioctx.exec(
+            BUCKETS_OID, "rgw_index", "dir_set",
+            json.dumps({"name": bucket["name"], "patch": {
+                k: bucket.get(k)
+                for k in ("notifications",)}}).encode())
+
     async def list_object_versions(self, bucket_name: str,
                                    prefix: str = "", marker: str = "",
                                    max_keys: int = 1000) -> dict:
@@ -256,7 +266,11 @@ class RgwStore:
                 for key, entry in listing["entries"]:
                     if self._mtime_age(entry["mtime"],
                                        now) >= days * 86400:
-                        await self.delete_object(bucket_name, key)
+                        await self.delete_object(bucket_name, key,
+                                                 notify=False)
+                        await self.notify.emit(
+                            bucket, "s3:ObjectLifecycle:Expiration:"
+                            "Current", key)
                         actions += 1
             nc_days = rule.get("noncurrent_days")
             if versioned and nc_days is not None:
@@ -269,6 +283,9 @@ class RgwStore:
                                        now) >= nc_days * 86400:
                         await self.delete_version(bucket_name, key,
                                                   vid)
+                        await self.notify.emit(
+                            bucket, "s3:ObjectLifecycle:Expiration:"
+                            "NoncurrentVersion", key, version_id=vid)
                         actions += 1
             if versioned and rule.get("expired_delete_marker"):
                 vl = await self.list_object_versions(
@@ -323,6 +340,8 @@ class RgwStore:
                 pass
             raise
         await self._purge_replaced(bucket, key, raw, soid)
+        await self.notify.emit(bucket, "s3:ObjectCreated:Put", key,
+                               size=len(data), etag=etag)
         return entry
 
     async def _put_object_versioned(self, bucket: dict, key: str,
@@ -360,10 +379,14 @@ class RgwStore:
                 pass
             raise
         await self._purge_replaced(bucket, key, raw, soid)
+        await self.notify.emit(bucket, "s3:ObjectCreated:Put", key,
+                               size=len(data),
+                               etag=entry["etag"], version_id=vid)
         return entry
 
     async def put_delete_marker(self, bucket: dict, key: str,
-                                suspended: bool) -> str:
+                                suspended: bool,
+                                notify: bool = True) -> str:
         """S3 DELETE in a versioned bucket: a delete MARKER becomes
         the current version; data stays."""
         vid = "null" if suspended else _new_version_id()
@@ -374,6 +397,10 @@ class RgwStore:
             json.dumps({"key": key, "entry": entry,
                         "suspended": suspended}).encode())
         await self._purge_replaced(bucket, key, raw, "")
+        if notify:
+            await self.notify.emit(
+                bucket, "s3:ObjectRemoved:DeleteMarkerCreated", key,
+                version_id=vid)
         return vid
 
     async def _purge_replaced(self, bucket: dict, key: str,
@@ -407,6 +434,9 @@ class RgwStore:
             self._index(bucket), "rgw_index", "complete",
             json.dumps({"key": key, "entry": entry}).encode())
         await self._purge_replaced(bucket, key, raw, "")
+        await self.notify.emit(
+            bucket, "s3:ObjectCreated:CompleteMultipartUpload", key,
+            size=entry.get("size", 0), etag=entry.get("etag", ""))
         return entry
 
     async def get_entry(self, bucket_name: str, key: str,
@@ -462,13 +492,14 @@ class RgwStore:
                 break
         return b"".join(out)
 
-    async def delete_object(self, bucket_name: str,
-                            key: str) -> str | None:
+    async def delete_object(self, bucket_name: str, key: str,
+                            notify: bool = True) -> str | None:
         bucket = await self.get_bucket(bucket_name)
         versioning = bucket.get("versioning", "")
         if versioning:
             return await self.put_delete_marker(
-                bucket, key, suspended=versioning == "Suspended")
+                bucket, key, suspended=versioning == "Suspended",
+                notify=notify)
         try:
             raw = await self.ioctx.exec(
                 self._index(bucket), "rgw_index", "unlink",
@@ -481,6 +512,9 @@ class RgwStore:
         # deletes cannot double-free, and a racing PUT's fresh
         # generation is never touched
         await self._purge_replaced(bucket, key, raw, "")
+        if notify:
+            await self.notify.emit(bucket, "s3:ObjectRemoved:Delete",
+                                   key)
 
     async def list_objects(self, bucket_name: str, prefix: str = "",
                            marker: str = "", max_keys: int = 1000,

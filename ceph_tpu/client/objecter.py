@@ -214,13 +214,26 @@ class Objecter:
         """Run ops on the object's primary, retrying through map churn."""
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout
-        last_err = None
         # reqid is stable across RESENDS of this op (unlike the per-
         # attempt tid) so the PG can detect and absorb duplicates
         # (osd_reqid_t semantics)
         reqid = [f"{self.msgr.name}:{self.msgr.incarnation}",
                  next(self._reqid_serial)]
         await self._maybe_refresh_tickets()
+        from ..common.tracing import get_tracer
+        span = get_tracer(self.msgr.name).start(
+            "client.osd_op", oid=oid, pool=pool_id)
+        try:
+            return await self._op_attempts(
+                span, pool_id, oid, ops, nspace, deadline, timeout,
+                attempt_timeout, ps, extra, reqid, loop)
+        finally:
+            span.finish()
+
+    async def _op_attempts(self, span, pool_id, oid, ops, nspace,
+                           deadline, timeout, attempt_timeout, ps,
+                           extra, reqid, loop):
+        last_err = None
         while loop.time() < deadline:
             pgid, primary = self.calc_target(pool_id, oid, nspace, ps=ps)
             if primary is None:
@@ -240,6 +253,7 @@ class Objecter:
                     Message("osd_op", {"pgid": pgid, "oid": oid,
                                        "ops": meta, "tid": tid,
                                        "reqid": reqid,
+                                       "trace": span.ctx(),
                                        **(extra or {})},
                             segments=segs))
                 reply = await asyncio.wait_for(

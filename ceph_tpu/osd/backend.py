@@ -149,6 +149,20 @@ class PGBackend:
     def coll(self) -> str:
         return self.pg.coll
 
+    def _queue_txn_traced(self, txn: Transaction, oid: str) -> None:
+        """Commit the txn with a store.txn span when an op trace is
+        active on this task (the client->OSD->store hop chain)."""
+        from ..common.tracing import current_span, get_tracer
+        cur = current_span.get()
+        if cur is None:
+            self.store.queue_transaction(txn)
+            return
+        sp = get_tracer(cur._tracer.daemon).start("store.txn", oid=oid)
+        try:
+            self.store.queue_transaction(txn)
+        finally:
+            sp.finish()
+
     async def submit_transaction(self, entry: LogEntry,
                                  muts: list[dict]) -> None:
         raise NotImplementedError
@@ -216,7 +230,7 @@ class ReplicatedBackend(PGBackend):
             txn.setattr(self.coll, entry.oid, VER_XATTR,
                         ver_encode(entry.version))
         self.pg.append_log_and_meta(txn, entry)
-        self.store.queue_transaction(txn)
+        self._queue_txn_traced(txn, entry.oid)
         # fan out to every other acting replica and wait for all commits
         # (ReplicatedBackend.cc: all_commit before client reply).
         # Backfill targets beyond their last_backfill watermark get the
@@ -224,6 +238,9 @@ class ReplicatedBackend(PGBackend):
         # arrives when the backfill scan reaches it, but their log/
         # last_update must stay in step with the acting set.
         meta, segs = pack_mutations(muts)
+        from ..common.tracing import current_span
+        cur = current_span.get()
+        tr = {"trace": cur.ctx()} if cur is not None else {}
         targets = []
         for o in self.pg.acting:
             if o < 0 or o == self.osd.whoami:
@@ -232,12 +249,13 @@ class ReplicatedBackend(PGBackend):
                 targets.append((o, "rep_op",
                                 {"pgid": self.pg.pgid,
                                  "entry": entry.to_dict(),
-                                 "muts": meta}, segs))
+                                 "muts": meta, **tr}, segs))
             else:
                 targets.append((o, "rep_op",
                                 {"pgid": self.pg.pgid,
                                  "entry": entry.to_dict(),
-                                 "muts": [], "log_only": True}, []))
+                                 "muts": [], "log_only": True,
+                                 **tr}, []))
         await self._fanout_commits(targets, entry)
 
     def apply_rep_op(self, entry: LogEntry, muts: list[dict],
@@ -250,7 +268,7 @@ class ReplicatedBackend(PGBackend):
                 txn.setattr(self.coll, entry.oid, VER_XATTR,
                             ver_encode(entry.version))
         self.pg.append_log_and_meta(txn, entry)
-        self.store.queue_transaction(txn)
+        self._queue_txn_traced(txn, entry.oid)
 
     async def object_read(self, oid, off, length) -> bytes:
         return self.store.read(self.coll, oid, off, length)

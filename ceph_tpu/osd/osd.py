@@ -257,6 +257,14 @@ class OSD:
         sock.register("dump_historic_ops_by_duration",
                       "slowest completed ops",
                       historic_ops_by_duration)
+        async def dump_tracing(req):
+            from ..common.tracing import get_tracer
+            return get_tracer(f"osd.{self.whoami}").dump(
+                (req or {}).get("trace_id"))
+
+        sock.register("dump_tracing",
+                      "finished trace spans (optionally one trace_id)",
+                      dump_tracing)
         sock.register("config show", "all config values", config_show)
         sock.register("scrub", "scrub a pg: {pgid, repair}", scrub_cmd)
         sock.register("config get", "describe one option", config_get)
@@ -882,6 +890,16 @@ class OSD:
                 "osd_op_reply", {"tid": msg.data.get("tid"),
                                  "err": "ENXIO no such pg"}))
             return
+        from ..common.tracing import get_tracer
+        span = get_tracer(f"osd.{self.whoami}").start(
+            "osd.do_op", parent=msg.data.get("trace"),
+            pgid=msg.data["pgid"], oid=msg.data["oid"]).activate()
+        try:
+            await self._do_osd_op_traced(conn, msg, pg)
+        finally:
+            span.finish()
+
+    async def _do_osd_op_traced(self, conn, msg, pg) -> None:
         op_names = [o.get("op") for o in msg.data.get("ops", [])]
         top = self.op_tracker.create(
             oid=msg.data["oid"], pgid=msg.data["pgid"],
@@ -908,6 +926,16 @@ class OSD:
 
     # replication / EC sub-ops
     async def _h_rep_op(self, conn, msg) -> None:
+        from ..common.tracing import get_tracer
+        span = get_tracer(f"osd.{self.whoami}").start(
+            "osd.rep_op", parent=msg.data.get("trace"),
+            pgid=msg.data["pgid"]).activate()
+        try:
+            await self._h_rep_op_traced(conn, msg)
+        finally:
+            span.finish()
+
+    async def _h_rep_op_traced(self, conn, msg) -> None:
         from .types import LogEntry
         from .backend import unpack_mutations
         pg = self._get_pg(msg.data["pgid"])

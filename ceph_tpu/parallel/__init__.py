@@ -12,6 +12,8 @@ from .sharded_ec import (  # noqa: F401
     lrc_sharded_encode,
     lrc_sharded_local_repair,
     make_mesh,
+    sharded_cross_recovery,
     sharded_encode,
     sharded_ec_step,
+    sharded_rmw,
 )

@@ -55,36 +55,43 @@ def _gf_matmul_bits(w_i8: jnp.ndarray, data_u8: jnp.ndarray) -> jnp.ndarray:
     return (b << shifts).sum(axis=1).astype(jnp.uint8)
 
 
+def _sharded_gf_apply(mesh: Mesh, matrix: np.ndarray,
+                      x: jnp.ndarray) -> jnp.ndarray:
+    """Apply a GF(2^8) matrix to shard-axis-scattered chunks: every
+    device all_gathers the input shards over 'shard' (the ICI hop),
+    computes ITS slice of the output rows, and the row slices
+    reassemble on the shard axis.  The shared scaffolding under both
+    the parity encode and the recovery decode."""
+    r = matrix.shape[0]
+    w = jnp.asarray(bitmatrix_i8(matrix))
+    n_shard = mesh.shape["shard"]
+    r_pad = ((r + n_shard - 1) // n_shard) * n_shard
+    w_full = jnp.zeros((8 * r_pad, w.shape[1]),
+                       jnp.int8).at[:8 * r].set(w)
+
+    def block(w_local, chunks):
+        gathered = jax.lax.all_gather(
+            chunks, "shard", axis=1, tiled=True)
+        bl, kk, ll = gathered.shape
+        flat = gathered.transpose(1, 0, 2).reshape(kk, bl * ll)
+        rows = _gf_matmul_bits(w_local, flat)
+        return rows.reshape(-1, bl, ll).transpose(1, 0, 2)
+
+    out = shard_map(
+        block, mesh=mesh,
+        in_specs=(P("shard", None), P("stripe", "shard", None)),
+        out_specs=P("stripe", "shard", None),
+    )(w_full, x)
+    return out[:, :r]
+
+
 def sharded_encode(mesh: Mesh, encode_matrix: np.ndarray, k: int,
                    data: jnp.ndarray) -> jnp.ndarray:
     """(B, k, L) -> (B, m, L) with B over 'stripe' and k over 'shard'.
 
     Requires B % mesh.stripe == 0 and k % mesh.shard == 0.
     """
-    m = encode_matrix.shape[0] - k
-    w = jnp.asarray(bitmatrix_i8(encode_matrix[k:]))
-    n_shard = mesh.shape["shard"]
-    # parity rows are split across the shard axis; pad m up if needed
-    m_pad = ((m + n_shard - 1) // n_shard) * n_shard
-
-    def block(w_local, chunks):
-        # chunks: (B_local, k_local, L): my slice of the data shards
-        gathered = jax.lax.all_gather(
-            chunks, "shard", axis=1, tiled=True)  # (B_local, k, L)
-        bl, kk, ll = gathered.shape
-        flat = gathered.transpose(1, 0, 2).reshape(kk, bl * ll)
-        parity = _gf_matmul_bits(w_local, flat)  # (m_local, B*L)
-        out = parity.reshape(-1, bl, ll).transpose(1, 0, 2)
-        return out
-
-    w_full = jnp.zeros((8 * m_pad, w.shape[1]), jnp.int8).at[:8 * m].set(w)
-    fn = shard_map(
-        block, mesh=mesh,
-        in_specs=(P("shard", None), P("stripe", "shard", None)),
-        out_specs=P("stripe", "shard", None),
-    )
-    out = fn(w_full, data)
-    return out.reshape(data.shape[0], m_pad, data.shape[2])[:, :m]
+    return _sharded_gf_apply(mesh, encode_matrix[k:], data)
 
 
 def sharded_ec_step(mesh: Mesh, encode_matrix: np.ndarray,
@@ -124,6 +131,33 @@ def sharded_ec_step(mesh: Mesh, encode_matrix: np.ndarray,
         out_specs=P("stripe"),
     )(recovered)
     return parity, recovered, csum
+
+
+def sharded_rmw(mesh: Mesh, encode_matrix: np.ndarray, k: int,
+                old_parity: jnp.ndarray,
+                delta: jnp.ndarray) -> jnp.ndarray:
+    """Partial-stripe read-modify-write parity update (the sharded
+    rendering of ECCommon.cc:704-789's RMW pipeline): GF(2^8) codes
+    are linear, so new_parity = old_parity XOR encode(new XOR old)
+    touches only the changed bytes' encode -- no full-stripe re-read.
+    ``delta`` is (B, k, L) with zeros outside the written range; the
+    encode rides the same (stripe, shard) mesh + ICI all_gather as the
+    full-stripe path.
+    """
+    pdelta = sharded_encode(mesh, encode_matrix, k, delta)
+    return jnp.bitwise_xor(old_parity, pdelta)
+
+
+def sharded_cross_recovery(mesh: Mesh, decode_matrix: np.ndarray,
+                           survivors: jnp.ndarray) -> jnp.ndarray:
+    """Reconstruct erased shards when the SURVIVORS are sharded over
+    the 'shard' mesh axis -- each device holds only its slice, so the
+    reconstruction needs a cross-chip all_gather over ICI first (the
+    network reads ECBackend recovery issues to the surviving OSDs,
+    ECCommon.cc recovery reads), then decodes locally.  Survivors:
+    (B, k, L), k divisible by the shard axis.
+    """
+    return _sharded_gf_apply(mesh, decode_matrix, survivors)
 
 
 # -- LRC over mesh sub-axes --------------------------------------------------

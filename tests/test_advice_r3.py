@@ -82,7 +82,11 @@ def test_rbd_fence_on_lock_loss():
 
             # steal the lock out from under the first client (what a
             # lock break + re-acquire by another client does)
-            await rbdmod.Image.break_lock(io, "img")
+            # blocklist=False: this test shares ONE rados client
+            # between holder and breaker, and exercises the renewal-
+            # based fence specifically (the blocklist path has its own
+            # test in test_blocklist.py)
+            await rbdmod.Image.break_lock(io, "img", blocklist=False)
             img2 = await rbdmod.Image.open(io, "img")
 
             # force the first handle's renewal NOW instead of waiting

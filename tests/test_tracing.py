@@ -1,0 +1,80 @@
+"""Cross-daemon trace spans: one client op's trace id flows
+client -> primary -> replicas -> store, and the assembled spans form
+the full hop tree (src/common/tracer.h role).
+"""
+
+import asyncio
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.common.tracing import all_spans, get_tracer
+from ceph_tpu.mon import Monitor
+from ceph_tpu.osd import OSD
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_trace_spans_cover_every_hop():
+    async def main():
+        mon = Monitor(rank=0, config={"mon_osd_min_down_reporters": 1})
+        addr = await mon.start()
+        mon.peer_addrs = [addr]
+        osds = []
+        for i in range(3):
+            o = OSD(host=f"h{i}", whoami=i)
+            await o.start(addr)
+            osds.append(o)
+        r = Rados(addr, name="client.traced")
+        await r.connect()
+        await r.mon_command("osd pool create",
+                            {"name": "p", "pg_num": 4, "size": 3})
+        io = await r.open_ioctx("p")
+        await io.write_full("traced-obj", b"follow me" * 100)
+
+        # the client's root span carries the trace id
+        client_spans = get_tracer("client.traced").dump()
+        roots = [s for s in client_spans
+                 if s["name"] == "client.osd_op"
+                 and s["tags"].get("oid") == "traced-obj"]
+        assert roots, "client root span missing"
+        trace_id = roots[-1]["trace_id"]
+
+        spans = all_spans(trace_id)
+        names = [s["name"] for s in spans]
+        assert "client.osd_op" in names
+        assert "osd.do_op" in names
+        # size=3 pool: two replicas each record a rep_op span
+        assert names.count("osd.rep_op") == 2
+        # the store commit is traced on the primary AND both replicas
+        assert names.count("store.txn") == 3
+        # every span belongs to the same trace and timing is recorded
+        for s in spans:
+            assert s["trace_id"] == trace_id
+            assert s["duration_ms"] is not None
+
+        # hop TREE: every non-root span's parent exists in the trace
+        by_id = {s["span_id"]: s for s in spans}
+        root = [s for s in spans if s["parent_id"] is None]
+        assert len(root) == 1 and root[0]["name"] == "client.osd_op"
+        for s in spans:
+            if s["parent_id"] is not None:
+                assert s["parent_id"] in by_id, \
+                    f"orphan span {s['name']}"
+        # rep_op spans hang off the primary's do_op span
+        do_op = next(s for s in spans if s["name"] == "osd.do_op")
+        for s in spans:
+            if s["name"] == "osd.rep_op":
+                assert s["parent_id"] == do_op["span_id"]
+        # daemons differ across hops: client + primary + 2 replicas
+        assert len({s["daemon"] for s in spans}) == 4
+
+        await r.shutdown()
+        for o in osds:
+            await o.stop()
+        await mon.stop()
+    run(main())

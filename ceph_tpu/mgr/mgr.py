@@ -252,6 +252,50 @@ class StatusModule(MgrModule):
         }
 
 
+class TelemetryModule(MgrModule):
+    """Anonymized cluster report (src/pybind/mgr/telemetry): opt-in,
+    aggregates non-identifying facts -- daemon counts, pool shapes,
+    usage -- into the report a phone-home channel would send (no
+    egress in this environment; the report is inspectable instead)."""
+
+    name = "telemetry"
+
+    def __init__(self, mgr: "Mgr") -> None:
+        super().__init__(mgr)
+        self.enabled = bool(mgr.config.get("telemetry_on", False))
+
+    def report(self) -> dict:
+        m = self.mgr.osdmap
+        osds = list(m.osds.values())
+        return {
+            "report_version": 1,
+            "osd": {"count": len(osds),
+                    "up": sum(1 for o in osds if o.up),
+                    "in": sum(1 for o in osds if o.in_cluster)},
+            "pools": [{"type": p.type, "size": p.size,
+                       "pg_num": p.pg_num,
+                       "erasure_code_profile":
+                           bool(p.erasure_code_profile)}
+                      for p in m.pools.values()],
+            "daemons": sorted(self.mgr.daemon_reports),
+            "crush": {"buckets": len(m.crush.buckets),
+                      "rules": len(m.crush.rules)},
+        }
+
+    async def handle_command(self, cmd: str, args: dict):
+        if cmd == "status":
+            return {"enabled": self.enabled}
+        if cmd == "on":
+            self.enabled = True
+            return ""
+        if cmd == "off":
+            self.enabled = False
+            return ""
+        if cmd == "show":
+            return self.report()
+        raise ValueError(f"unknown telemetry command {cmd!r}")
+
+
 class Mgr:
     def __init__(self, name: str = "x",
                  config: dict | None = None,
@@ -274,7 +318,8 @@ class Mgr:
         self.log: list[str] = []
         self.modules: dict[str, MgrModule] = {}
         for cls in (BalancerModule, PgAutoscalerModule, StatusModule,
-                    PrometheusModule, ProgressModule):
+                    PrometheusModule, ProgressModule,
+                    TelemetryModule):
             mod = cls(self)
             self.modules[mod.name] = mod
         self._tasks: list[asyncio.Task] = []

@@ -946,21 +946,66 @@ class Monitor:
                        f"oldest {msg.data.get('oldest_age', 0):.0f}s",
                 who=f"osd.{osd}"))
 
+    MGR_BEACON_GRACE = 8.0
+
     async def _h_mgr_beacon(self, conn, msg) -> None:
-        """Track the active mgr and publish its address to subscribers
-        (the MgrMap analog; MgrMonitor::prepare_beacon)."""
-        addr = tuple(msg.data["addr"])
-        changed = getattr(self, "mgr_addr", None) != addr
-        self.mgr_addr = addr
-        self.mgr_name = msg.data.get("name", "")
-        self.mgr_last_beacon = time.monotonic()
+        """MgrMonitor::prepare_beacon: the LEADER owns the replicated
+        MgrMap -- first mgr to beacon becomes active, later ones stand
+        by, and a lapsed active is deposed in _tick with a standby
+        promoted.  Peons forward so the map is mon-agnostic."""
+        name = msg.data.get("name", "")
+        addr = list(msg.data["addr"])
+        if not self.is_leader:
+            if self.leader is not None:
+                await self._send_mon(self.leader, Message(
+                    "mgr_beacon", dict(msg.data)))
+            return
+        beats = getattr(self, "mgr_last_beacon", None)
+        if beats is None:
+            beats = self.mgr_last_beacon = {}
+        beats[name] = time.monotonic()
+        m = dict(self.services.mgrmap)
+        changed = False
+        if m.get("active") is None:
+            m.update({"active": name, "active_addr": addr,
+                      "epoch": m["epoch"] + 1,
+                      "standbys": [x for x in m.get("standbys", [])
+                                   if x["name"] != name]})
+            changed = True
+        elif m["active"] == name:
+            if m.get("active_addr") != addr:
+                m.update({"active_addr": addr,
+                          "epoch": m["epoch"] + 1})
+                changed = True
+        else:
+            stand = list(m.get("standbys", []))
+            cur = next((x for x in stand if x["name"] == name), None)
+            if cur is None:
+                m["standbys"] = stand + [{"name": name, "addr": addr}]
+                m["epoch"] += 1
+                changed = True
+            elif cur["addr"] != addr:
+                # a restarted standby's NEW address must be the one a
+                # later failover promotes
+                cur["addr"] = addr
+                m["standbys"] = stand
+                m["epoch"] += 1
+                changed = True
         if changed:
-            payload = {"name": self.mgr_name, "addr": list(addr)}
-            for name, sub in list(self.subscribers.items()):
-                try:
-                    await sub.send(Message("mgr_map", payload))
-                except (ConnectionError, OSError):
-                    self.subscribers.pop(name, None)
+            await self.propose_service_kv(
+                "mgrmap", {"map": json.dumps(m)})
+            await self._publish_mgr_map()
+
+    async def _publish_mgr_map(self) -> None:
+        m = self.services.mgrmap
+        if not m.get("active"):
+            return
+        payload = {"name": m["active"], "addr": m["active_addr"]}
+        for name, sub in list(self.subscribers.items()):
+            try:
+                await sub.send(Message("mgr_map", payload))
+            except (ConnectionError, OSError):
+                self.subscribers.pop(name, None)
 
     async def _h_sub_osdmap(self, conn, msg) -> None:
         self.subscribers[msg.from_name] = conn
@@ -969,10 +1014,11 @@ class Monitor:
         cfg = self.services.config_for(msg.from_name)
         if cfg:                  # central config lands at subscription
             await conn.send(Message("config_update", {"config": cfg}))
-        if getattr(self, "mgr_addr", None):   # late joiners learn the mgr
+        mgrm = self.services.mgrmap
+        if mgrm.get("active"):             # late joiners learn the mgr
             await conn.send(Message("mgr_map",
-                                    {"name": self.mgr_name,
-                                     "addr": list(self.mgr_addr)}))
+                                    {"name": mgrm["active"],
+                                     "addr": mgrm["active_addr"]}))
 
     async def _h_get_osdmap(self, conn, msg) -> None:
         since = msg.data.get("since", 0)
@@ -1306,6 +1352,34 @@ class Monitor:
             for osd in to_out:
                 self._down_since.pop(osd, None)
             await self.propose(inc)
+        # MgrMonitor: a lapsed active mgr is deposed and a standby
+        # promoted (mgr failover)
+        if self.is_leader:
+            m = self.services.mgrmap
+            beats = getattr(self, "mgr_last_beacon", None)
+            if beats is None:
+                beats = self.mgr_last_beacon = {}
+            act = m.get("active")
+            if act and act not in beats:
+                # a NEW leader has no beat record for the active: start
+                # the grace clock now instead of resetting it each tick
+                # (else a dead active is never deposed after a mon
+                # leadership change)
+                beats[act] = now
+            if act and now - beats[act] > self.MGR_BEACON_GRACE:
+                nm = dict(m)
+                nm["epoch"] += 1
+                stand = nm.get("standbys", [])
+                if stand:
+                    nxt = stand[0]
+                    nm.update({"active": nxt["name"],
+                               "active_addr": nxt["addr"],
+                               "standbys": stand[1:]})
+                else:
+                    nm.update({"active": None, "active_addr": None})
+                await self.propose_service_kv(
+                    "mgrmap", {"map": json.dumps(nm)})
+                await self._publish_mgr_map()
         # expired blocklist entries leave the map (OSDMonitor::tick
         # does the same sweep); without it every fence ever made rides
         # in every full map forever

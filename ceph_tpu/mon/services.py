@@ -47,6 +47,14 @@ class MonServices:
         # paxos like every service; beacon liveness is in-memory on
         # the leader (mds_last_beacon on the Monitor).
         self.fsmap: dict = {"epoch": 0, "active": None, "standbys": []}
+        # MgrMap (MgrMonitor): which mgr is active, who stands by --
+        # replicated so a peon answers mgr_map and failover survives
+        # mon leadership changes (src/mon/MgrMonitor.cc)
+        self.mgrmap: dict = {"epoch": 0, "active": None,
+                             "active_addr": None, "standbys": []}
+        # KVMonitor (config-key store, src/mon/KVMonitor.cc): the
+        # cluster-wide durable key/value stash ("ceph config-key ...")
+        self.kv_db: dict[str, str] = {}
         # replicated cephx rotating service keys: service -> dict
         self.cephx_keys: dict[str, dict] = {}
 
@@ -78,6 +86,22 @@ class MonServices:
         if fsval is not None:
             self.fsmap = (json.loads(fsval)
                           if isinstance(fsval, str) else fsval)
+        mgrval = service_kv.get("mgrmap", {}).get("map")
+        if mgrval is not None:
+            self.mgrmap = (json.loads(mgrval)
+                           if isinstance(mgrval, str) else mgrval)
+            # EVERY mon pushes the new mgr_map to its own subscribers
+            # (daemons may be sessioned to a peon)
+            import asyncio as _asyncio
+            try:
+                _asyncio.ensure_future(self.mon._publish_mgr_map())
+            except RuntimeError:
+                pass          # replay outside a loop (mon boot)
+        for key, val in service_kv.get("kvstore", {}).items():
+            if val is None:
+                self.kv_db.pop(key, None)
+            else:
+                self.kv_db[key] = val
         for _, val in sorted(service_kv.get("log", {}).items()):
             entry = json.loads(val) if isinstance(val, str) else val
             self.cluster_log.append(entry)
@@ -164,12 +188,14 @@ class MonServices:
                 "detail": [f"osd.{o}: {r['count']} ops, oldest "
                            f"{r['oldest_age']:.0f}s"
                            for o, r in sorted(slow.items())]}
-        beat = getattr(mon, "mgr_last_beacon", 0.0)
-        if getattr(mon, "mgr_addr", None) and beat \
+        act = self.mgrmap.get("active")
+        beats = getattr(mon, "mgr_last_beacon", None) or {}
+        beat = beats.get(act) if act else None
+        if act and beat is not None \
                 and time.monotonic() - beat > 30.0:
             checks["MGR_DOWN"] = {
                 "severity": "HEALTH_WARN",
-                "summary": "no mgr beacon for 30s",
+                "summary": f"no beacon from active mgr {act} for 30s",
                 "detail": []}
         status = "HEALTH_OK"
         for c in checks.values():
@@ -199,6 +225,40 @@ class MonServices:
             return self.config_for(args.get("who", "global"))
         if cmd == "config dump":
             return dict(sorted(self.config_db.items()))
+        if cmd == "config-key set":
+            await mon.propose_service_kv(
+                "kvstore", {args["key"]: str(args["value"])})
+            return ""
+        if cmd == "config-key get":
+            if args["key"] not in self.kv_db:
+                raise ValueError(f"no such key {args['key']}")
+            return self.kv_db[args["key"]]
+        if cmd == "config-key rm":
+            await mon.propose_service_kv("kvstore",
+                                         {args["key"]: None})
+            return ""
+        if cmd == "config-key ls":
+            return sorted(self.kv_db)
+        if cmd == "mgr dump":
+            return dict(self.mgrmap)
+        if cmd == "mgr fail":
+            # depose the active and promote a standby NOW (not on the
+            # next beacon race, which the deposed mgr usually wins)
+            m = dict(self.mgrmap)
+            if m.get("active"):
+                m["epoch"] += 1
+                stand = m.get("standbys", [])
+                if stand:
+                    nxt = stand[0]
+                    m.update({"active": nxt["name"],
+                              "active_addr": nxt["addr"],
+                              "standbys": stand[1:]})
+                else:
+                    m.update({"active": None, "active_addr": None})
+                await mon.propose_service_kv(
+                    "mgrmap", {"map": json.dumps(m)})
+                await mon._publish_mgr_map()
+            return dict(m)
         if cmd == "auth get-or-create":
             entry = self.auth_get_or_create(args["entity"],
                                             args.get("caps"))

@@ -140,3 +140,141 @@ def test_cls_atomic_with_vector_and_failure():
         finally:
             await teardown(mon, osds, rados)
     run(main())
+
+
+def test_cls_numops_atomic_arithmetic():
+    async def main():
+        mon, osds = await make_cluster(2)
+        r = await Rados(mon.msgr.addr, name="client.n").connect()
+        try:
+            await r.pool_create("p", pg_num=4)
+            io = await r.open_ioctx("p")
+
+            async def op(m, key, value):
+                return await io.exec("counters", "numops", m,
+                                     json.dumps({"key": key,
+                                                 "value": value}
+                                                ).encode())
+            assert await op("add", "hits", 5) == b"5"
+            assert await op("add", "hits", 2.5) == b"7.5"
+            assert await op("sub", "hits", 0.5) == b"7"
+            assert await op("mul", "hits", 3) == b"21"
+            assert await op("div", "hits", 7) == b"3"
+            with pytest.raises(RadosError, match="EINVAL"):
+                await op("div", "hits", 0)
+        finally:
+            await teardown(mon, osds, r)
+    run(main())
+
+
+def test_cls_log_add_list_trim():
+    async def main():
+        mon, osds = await make_cluster(2)
+        r = await Rados(mon.msgr.addr, name="client.l").connect()
+        try:
+            await r.pool_create("p", pg_num=4)
+            io = await r.open_ioctx("p")
+            entries = [{"timestamp": 100.0 + i, "section": "meta",
+                        "name": f"e{i}", "data": f"payload {i}"}
+                       for i in range(6)]
+            await io.exec("log", "log", "add",
+                          json.dumps({"entries": entries}).encode())
+            # window list with paging
+            out = json.loads(await io.exec(
+                "log", "log", "list",
+                json.dumps({"from": 101.0, "to": 105.0,
+                            "max": 2}).encode()))
+            assert [e["name"] for e in out["entries"]] == ["e1", "e2"]
+            assert out["truncated"]
+            out2 = json.loads(await io.exec(
+                "log", "log", "list",
+                json.dumps({"from": 101.0, "to": 105.0, "max": 10,
+                            "marker": out["marker"]}).encode()))
+            assert [e["name"] for e in out2["entries"]] == ["e3", "e4"]
+            assert not out2["truncated"]
+            # trim the consumed window
+            await io.exec("log", "log", "trim",
+                          json.dumps({"from": 0, "to": 103.5}).encode())
+            rest = json.loads(await io.exec(
+                "log", "log", "list", json.dumps({}).encode()))
+            assert [e["name"] for e in rest["entries"]] == \
+                ["e4", "e5"]
+        finally:
+            await teardown(mon, osds, r)
+    run(main())
+
+
+def test_cls_timeindex_and_queue():
+    async def main():
+        mon, osds = await make_cluster(2)
+        r = await Rados(mon.msgr.addr, name="client.t").connect()
+        try:
+            await r.pool_create("p", pg_num=4)
+            io = await r.open_ioctx("p")
+            await io.exec("ti", "timeindex", "add", json.dumps({
+                "entries": [{"timestamp": 10.0 + i,
+                             "key_suffix": f"k{i}",
+                             "value": {"n": i}} for i in range(4)]
+            }).encode())
+            out = json.loads(await io.exec(
+                "ti", "timeindex", "list",
+                json.dumps({"from": 11.0, "to": 13.5}).encode()))
+            assert [e["key_suffix"] for e in out["entries"]] == \
+                ["k1", "k2", "k3"]
+            await io.exec("ti", "timeindex", "trim",
+                          json.dumps({"to": 12.0}).encode())
+            out2 = json.loads(await io.exec(
+                "ti", "timeindex", "list", json.dumps({}).encode()))
+            assert [e["key_suffix"] for e in out2["entries"]] == \
+                ["k2", "k3"]
+
+            # queue: fifo order, marker paging, prefix ack
+            await io.exec("q", "queue", "enqueue", json.dumps({
+                "entries": [{"id": i} for i in range(5)]}).encode())
+            got = json.loads(await io.exec(
+                "q", "queue", "list", json.dumps({"max": 3}).encode()))
+            assert [e["id"] for e in got["entries"]] == [0, 1, 2]
+            await io.exec("q", "queue", "remove", json.dumps({
+                "end_marker": got["marker"]}).encode())
+            rest = json.loads(await io.exec(
+                "q", "queue", "list", json.dumps({}).encode()))
+            assert [e["id"] for e in rest["entries"]] == [3, 4]
+        finally:
+            await teardown(mon, osds, r)
+    run(main())
+
+
+def test_cls_user_accounting():
+    async def main():
+        mon, osds = await make_cluster(2)
+        r = await Rados(mon.msgr.addr, name="client.u").connect()
+        try:
+            await r.pool_create("p", pg_num=4)
+            io = await r.open_ioctx("p")
+            await io.exec("u.alice", "user", "set_buckets_info",
+                          json.dumps({"entries": [
+                              {"bucket": "b1", "size": 100,
+                               "count": 3, "creation_time": 1.0},
+                              {"bucket": "b2", "size": 50,
+                               "count": 1}]}).encode())
+            await io.exec("u.alice", "user", "set_buckets_info",
+                          json.dumps({"add": True, "entries": [
+                              {"bucket": "b1", "size": 20,
+                               "count": 2}]}).encode())
+            hdr = json.loads(await io.exec("u.alice", "user",
+                                           "get_header", b""))
+            assert hdr == {"stats": {"size": 170, "count": 6},
+                           "buckets": 2}
+            lst = json.loads(await io.exec(
+                "u.alice", "user", "list_buckets",
+                json.dumps({}).encode()))
+            assert [b["bucket"] for b in lst["entries"]] == \
+                ["b1", "b2"]
+            await io.exec("u.alice", "user", "remove_bucket",
+                          json.dumps({"bucket": "b1"}).encode())
+            with pytest.raises(RadosError, match="ENOENT"):
+                await io.exec("u.alice", "user", "remove_bucket",
+                              json.dumps({"bucket": "b1"}).encode())
+        finally:
+            await teardown(mon, osds, r)
+    run(main())

@@ -117,3 +117,71 @@ def test_mgr_active_balancer_flattens_skew():
             await mgr.stop()
             await teardown(mon, osds)
     run(main())
+
+
+def test_mgrmap_replicated_and_failover():
+    """MgrMonitor: the first mgr to beacon goes active in the
+    REPLICATED MgrMap, a second stands by, and when the active's
+    beacons lapse the standby promotes (mgr failover)."""
+    from ceph_tpu.mgr.mgr import Mgr
+
+    async def main():
+        mon, osds = await make_cluster(1)
+        a = Mgr(name="a", config={"beacon_interval": 0.3})
+        b = Mgr(name="b", config={"beacon_interval": 0.3})
+        try:
+            await a.start(mon.msgr.addr)
+            await b.start(mon.msgr.addr)
+            for _ in range(50):
+                m = mon.services.mgrmap
+                if m.get("active") == "a" and \
+                        [x["name"] for x in m["standbys"]] == ["b"]:
+                    break
+                await asyncio.sleep(0.1)
+            m = mon.services.mgrmap
+            assert m["active"] == "a"
+            assert [x["name"] for x in m["standbys"]] == ["b"]
+            dump = await mon.handle_command("mgr dump", {})
+            assert dump["active"] == "a"
+            # the active dies; the standby must promote within grace
+            await a.stop()
+            mon.MGR_BEACON_GRACE = 1.0
+            for _ in range(100):
+                if mon.services.mgrmap.get("active") == "b":
+                    break
+                await asyncio.sleep(0.1)
+            assert mon.services.mgrmap["active"] == "b"
+            await b.stop()
+        finally:
+            await teardown(mon, osds)
+    run(main())
+
+
+def test_config_key_store_and_telemetry():
+    from ceph_tpu.mgr.mgr import Mgr
+
+    async def main():
+        mon, osds = await make_cluster(1)
+        try:
+            # KVMonitor: durable cluster key/value stash
+            await mon.handle_command(
+                "config-key set", {"key": "mirror/peer", "value": "x"})
+            assert await mon.handle_command(
+                "config-key get", {"key": "mirror/peer"}) == "x"
+            assert await mon.handle_command("config-key ls", {}) == \
+                ["mirror/peer"]
+            await mon.handle_command("config-key rm",
+                                     {"key": "mirror/peer"})
+            assert await mon.handle_command("config-key ls", {}) == []
+
+            # telemetry report aggregates non-identifying facts
+            mgr = Mgr(name="t")
+            await mgr.start(mon.msgr.addr)
+            rep = await mgr.modules["telemetry"].handle_command(
+                "show", {})
+            assert rep["osd"]["count"] == 1
+            assert "report_version" in rep
+            await mgr.stop()
+        finally:
+            await teardown(mon, osds)
+    run(main())

@@ -94,7 +94,8 @@ class RBD:
         return sorted(json.loads(out))
 
     async def remove(self, ioctx, name: str) -> None:
-        img = await Image.open(ioctx, name, read_only=True)
+        img = await Image.open(ioctx, name, read_only=True,
+                               admin=True)
         try:
             if any(s.get("protected") for s in img.meta["snapshots"]):
                 raise RbdError("EBUSY", "image has protected snapshots")
@@ -190,6 +191,10 @@ class Image:
         self._fenced = False
         # write-back cache (ObjectCacher), bound at open(cache=True)
         self.cacher = None
+        # DATA-path ioctx: plain, or a CryptoIoCtx when the image is
+        # encrypted (crypto sits below the cache, above the wire)
+        self._dio = ioctx
+        self._no_data_key = False
         # feature handles (object-map / journaling), bound at open
         from .features import (FEATURE_JOURNALING, FEATURE_OBJECT_MAP,
                                ImageJournal, ObjectMap)
@@ -204,7 +209,9 @@ class Image:
     async def open(ioctx, name: str, snapshot: str | None = None,
                    read_only: bool = False,
                    exclusive: bool = True,
-                   cache: bool = False) -> "Image":
+                   cache: bool = False,
+                   passphrase: str | None = None,
+                   admin: bool = False) -> "Image":
         """``exclusive=False`` opens writable WITHOUT taking the image
         lock -- for snapshot-only administrative handles (rbd-mirror
         snapshots a live image without stealing the client's lock; the
@@ -232,6 +239,35 @@ class Image:
         snap_id = None
         img = Image(ioctx, name, iid, meta, read_only or bool(snapshot),
                     snap_id)
+        # encryption gate BEFORE any data I/O: an encrypted image
+        # without its passphrase must refuse, not serve ciphertext
+        from .crypto import (CryptoIoCtx, ENVELOPE_XATTR,
+                             WrongPassphrase, unwrap_key)
+        try:
+            env_raw = await ioctx.get_xattr(_header(iid),
+                                            ENVELOPE_XATTR)
+        except RadosError as e:
+            # ONLY absence means unencrypted; a transient error must
+            # not bypass the gate and serve ciphertext as plaintext
+            if e.errno_name not in ("ENOENT", "ENODATA"):
+                raise _wrap(e) from e
+            env_raw = None
+        if env_raw and passphrase is None:
+            if not admin:
+                raise RbdError(
+                    "EPERM", "image is encrypted; passphrase required")
+            # administrative handle (remove, status): may touch
+            # metadata and delete objects, but data I/O is refused --
+            # it would serve ciphertext as plaintext
+            img._no_data_key = True
+        if passphrase is not None:
+            if not env_raw:
+                raise RbdError("EINVAL", "image is not encrypted")
+            try:
+                key = unwrap_key(json.loads(env_raw), passphrase)
+            except WrongPassphrase as e:
+                raise RbdError("EPERM", str(e)) from e
+            img._dio = CryptoIoCtx(img.ioctx, key)
         if snapshot is not None:
             img.snap_id = img._snap_by_name(snapshot)["id"]
         if not img.read_only and exclusive:
@@ -251,9 +287,32 @@ class Image:
                 _header(img.id), img._on_header_notify)
             if cache:
                 from ..client.object_cacher import ObjectCacher
-                img.cacher = ObjectCacher(img.ioctx)
+                img.cacher = ObjectCacher(img._dio)
         await img._refresh_snapc()
         return img
+
+    async def encryption_format(self, passphrase: str) -> None:
+        """Format THIS image for encryption (rbd encryption format):
+        writes the LUKS-style envelope and switches the data path to
+        AES-XTS.  Only valid on a fresh image -- existing plaintext
+        data is not re-encrypted (the reference has the same rule)."""
+        from .crypto import (CryptoIoCtx, ENVELOPE_XATTR,
+                             format_encryption)
+        self._writable_or_raise()
+        try:
+            existing = await self.ioctx.get_xattr(_header(self.id),
+                                                  ENVELOPE_XATTR)
+        except RadosError as e:
+            if e.errno_name not in ("ENOENT", "ENODATA"):
+                raise _wrap(e) from e
+            existing = None
+        if existing:
+            raise RbdError("EEXIST", "image is already encrypted")
+        key = await format_encryption(self.ioctx, _header(self.id),
+                                      passphrase)
+        self._dio = CryptoIoCtx(self.ioctx, key)
+        if self.cacher is not None:
+            self.cacher.ioctx = self._dio
 
     async def _on_header_notify(self, payload: bytes) -> None:
         try:
@@ -531,6 +590,9 @@ class Image:
 
     # -- data path ----------------------------------------------------------
     async def read(self, off: int, length: int) -> bytes:
+        if self._no_data_key:
+            raise RbdError("EPERM", "encrypted image opened without "
+                                    "its passphrase (admin handle)")
         size = await self.size()
         if off >= size:
             return b""
@@ -546,7 +608,7 @@ class Image:
                     # miss path inside the cacher: object read with
                     # hole -> parent/zero fallback (clone reads)
                     try:
-                        got = await self.ioctx.read(
+                        got = await self._dio.read(
                             self._data_obj(objectno), length=ln,
                             offset=o)
                         return got
@@ -562,7 +624,7 @@ class Image:
                     self._data_obj(objectno), obj_off, n, reader=miss)
                 return idx, buf, False
             try:
-                buf = await self.ioctx.read(
+                buf = await self._dio.read(
                     self._data_obj(objectno), length=n, offset=obj_off,
                     snap=self.snap_id)
                 return idx, buf + b"\0" * (n - len(buf)), False
@@ -602,12 +664,18 @@ class Image:
         buf = await self._read_parent(obj_logical, n)
         if buf.strip(b"\0"):
             try:
-                await self.ioctx.write(self._data_obj(objectno), buf,
-                                       offset=0)
+                # through the DATA path: on an encrypted clone the
+                # copied-up parent bytes must be stored as ciphertext,
+                # or the next RMW decrypts plaintext into garbage
+                await self._dio.write(self._data_obj(objectno), buf,
+                                      offset=0)
             except RadosError as e:
                 raise _wrap(e) from e
 
     async def write(self, off: int, data: bytes) -> int:
+        if self._no_data_key:
+            raise RbdError("EPERM", "encrypted image opened without "
+                                    "its passphrase (admin handle)")
         self._writable_or_raise()
         size = self.meta["size"]
         if off + len(data) > size:
@@ -638,8 +706,8 @@ class Image:
                 await self.cacher.write(self._data_obj(objectno),
                                         obj_off, piece)
             else:
-                await self.ioctx.write(self._data_obj(objectno),
-                                       piece, offset=obj_off)
+                await self._dio.write(self._data_obj(objectno),
+                                      piece, offset=obj_off)
 
         jobs = []
         pos = 0
@@ -693,7 +761,7 @@ class Image:
                         if e.errno_name != "ENOENT":
                             raise
                         await self._copyup(objectno)
-                await self.ioctx.zero(oid, obj_off, n)
+                await self._dio.zero(oid, obj_off, n)
             except RadosError as e:
                 if e.errno_name != "ENOENT":
                     raise
@@ -736,7 +804,7 @@ class Image:
             if new_size % lay.object_size and keep:
                 boundary = self._data_obj(keep - 1)
                 try:
-                    await self.ioctx.truncate(
+                    await self._dio.truncate(
                         boundary, new_size % lay.object_size)
                 except RadosError as e:
                     if e.errno_name != "ENOENT":

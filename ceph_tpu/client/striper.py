@@ -72,9 +72,15 @@ def map_extents(layout: Layout, off: int,
 class RadosStriper:
     """Striped I/O over an IoCtx (libradosstriper analog)."""
 
-    def __init__(self, ioctx, layout: Layout | None = None) -> None:
+    def __init__(self, ioctx, layout: Layout | None = None,
+                 atomic_size: bool = False) -> None:
         self.ioctx = ioctx
         self.layout = layout or Layout()
+        # atomic_size: size updates go through the cls striper
+        # grow_size op (atomic at the OSD) so CONCURRENT CLIENTS never
+        # lose a grow to a read-modify-write race; the default path is
+        # cheaper and fine for single-writer users (cephfs, rbd)
+        self.atomic_size = atomic_size
         # size-xattr updates are read-modify-write: serialize them per
         # logical object within this handle (SimpleRADOSStriper holds
         # an exclusive object lock for the same reason; cross-client
@@ -98,6 +104,12 @@ class RadosStriper:
             ops.append(self.ioctx.write(self._obj(soid, objectno),
                                         piece, offset=obj_off))
         await asyncio.gather(*ops)
+        if self.atomic_size:
+            import json as _json
+            await self.ioctx.exec(
+                self._obj(soid, 0), "striper", "grow_size",
+                _json.dumps({"size": off + len(data)}).encode())
+            return
         async with self._size_lock(soid):
             size = await self.size(soid)
             if off + len(data) > size:

@@ -30,7 +30,8 @@ _REGISTRY: dict[str, dict[str, tuple[int, object]]] = {}
 
 # in-tree modules, loaded on first call (dlopen-on-demand analog)
 _KNOWN = ("lock", "refcount", "version", "rbd", "rgw_index",
-          "journal", "numops", "log", "timeindex", "user", "queue")
+          "journal", "numops", "log", "timeindex", "user", "queue",
+          "striper")
 
 
 class ClsError(Exception):

@@ -64,6 +64,21 @@ def create(hctx, indata: bytes) -> bytes:
     return b""
 
 
+@register("rbd", "copyup", CLS_METHOD_RD | CLS_METHOD_WR)
+def copyup_op(hctx, indata: bytes) -> bytes:
+    """Materialize an object ONLY if it does not exist yet
+    (cls_rbd copyup): the atomic exists-check-and-write that lets a
+    migration/flatten copier race live client writes safely -- whoever
+    creates the object first wins, the loser no-ops."""
+    if hctx.exists():
+        return b""
+    if indata:
+        hctx.write_full(bytes(indata))
+    else:
+        hctx.create(exclusive=False)
+    return b""
+
+
 @register("rbd", "get_image_meta", CLS_METHOD_RD)
 def get_image_meta(hctx, indata: bytes) -> bytes:
     meta = _meta(hctx)

@@ -151,6 +151,16 @@ class CryptoIoCtx:
         await self.ioctx.write(oid, bytes(ct), offset=s0 * SECTOR)
         return len(data)
 
+    def encrypt_full(self, oid: str, data: bytes) -> bytes:
+        """Sector-encrypt a whole-object payload starting at offset 0
+        (for atomic cls copyup, which bypasses the write path)."""
+        pad = (len(data) + SECTOR - 1) // SECTOR * SECTOR
+        buf = bytes(data).ljust(pad, b"\x00")
+        ct = bytearray()
+        for i in range(0, pad, SECTOR):
+            ct += self._enc(oid, i // SECTOR, buf[i:i + SECTOR])
+        return bytes(ct)
+
     async def truncate(self, oid, size: int):
         # ciphertext is stored in whole sectors: cut on the next
         # sector boundary, then RE-ENCRYPT the kept sector's tail as

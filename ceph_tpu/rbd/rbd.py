@@ -97,6 +97,13 @@ class RBD:
         img = await Image.open(ioctx, name, read_only=True,
                                admin=True)
         try:
+            from .migration import (MIG_DST_XATTR, MIG_SRC_XATTR,
+                                    _get_marker)
+            for xattr in (MIG_SRC_XATTR, MIG_DST_XATTR):
+                if await _get_marker(ioctx, img.id, xattr):
+                    raise RbdError(
+                        "EBUSY", "image is migrating "
+                                 "(commit or abort first)")
             if any(s.get("protected") for s in img.meta["snapshots"]):
                 raise RbdError("EBUSY", "image has protected snapshots")
             if img.meta["snapshots"]:
@@ -195,6 +202,10 @@ class Image:
         # encrypted (crypto sits below the cache, above the wire)
         self._dio = ioctx
         self._no_data_key = False
+        # live migration: destination images fall through to the
+        # source for not-yet-copied data (librbd/migration)
+        self._mig_marker: dict | None = None
+        self._mig_src: "Image | None" = None
         # feature handles (object-map / journaling), bound at open
         from .features import (FEATURE_JOURNALING, FEATURE_OBJECT_MAP,
                                ImageJournal, ObjectMap)
@@ -270,6 +281,15 @@ class Image:
             img._dio = CryptoIoCtx(img.ioctx, key)
         if snapshot is not None:
             img.snap_id = img._snap_by_name(snapshot)["id"]
+        from .migration import (MIG_DST_XATTR, MIG_SRC_XATTR,
+                                _get_marker)
+        img._mig_marker, mig_dst = await asyncio.gather(
+            _get_marker(ioctx, iid, MIG_SRC_XATTR),
+            _get_marker(ioctx, iid, MIG_DST_XATTR))
+        if not img.read_only and mig_dst:
+            # this image is the SOURCE of a live migration: clients
+            # must use the destination; the source serves reads only
+            img.read_only = True
         if not img.read_only and exclusive:
             await img._acquire_lock()
             if img.journal is not None:
@@ -382,6 +402,9 @@ class Image:
         if self._parent is not None:
             await self._parent.close()
             self._parent = None
+        if self._mig_src is not None:
+            await self._mig_src.close()
+            self._mig_src = None
         if flush_err is not None:
             # teardown completed, but the final flush did not land:
             # the caller must know its last writes may be lost
@@ -574,6 +597,26 @@ class Image:
                                  True, pref["snap_id"])
         return self._parent
 
+    async def _mig_source_img(self) -> "Image | None":
+        if self._mig_marker is None:
+            return None
+        if self._mig_src is None:
+            from .migration import _open_source
+            self._mig_src = await _open_source(self)
+        return self._mig_src
+
+    async def _read_below(self, off: int, length: int) -> bytes:
+        """Data for a hole: the live-migration source if one exists,
+        else the clone parent, else zeros."""
+        src = await self._mig_source_img()
+        if src is not None:
+            n = min(length, max(0, src.meta["size"] - off))
+            buf = await src.read(off, n) if n else b""
+            return buf + b"\0" * (length - len(buf))
+        if self.meta.get("parent"):
+            return await self._read_parent(off, length)
+        return b"\0" * length
+
     async def _read_parent(self, off: int, length: int) -> bytes:
         """Read [off, off+length) from the parent snapshot, clipped to
         the overlap; beyond-overlap reads are zeros."""
@@ -615,10 +658,8 @@ class Image:
                     except RadosError as e:
                         if e.errno_name != "ENOENT":
                             raise
-                    if self.meta.get("parent"):
-                        return await self._read_parent(
-                            logical0 + (o - obj_off), ln)
-                    return b"\0" * ln
+                    return await self._read_below(
+                        logical0 + (o - obj_off), ln)
 
                 buf = await self.cacher.read(
                     self._data_obj(objectno), obj_off, n, reader=miss)
@@ -645,10 +686,7 @@ class Image:
         for idx, buf, hole in done:
             if hole:
                 n = extents[idx][2]
-                if self.meta.get("parent"):
-                    buf = await self._read_parent(logical[idx], n)
-                else:
-                    buf = b"\0" * n
+                buf = await self._read_below(logical[idx], n)
             pieces[idx] = buf
         return b"".join(pieces)
 
@@ -657,20 +695,31 @@ class Image:
         parent's bytes for the whole object first (CopyupRequest)."""
         lay = self._layout
         obj_logical = objectno * lay.object_size   # sc==1 path
-        overlap = min(self.meta["parent"]["overlap"], self.meta["size"])
-        if obj_logical >= overlap:
+        if self._mig_marker is not None:
+            bound = self.meta["size"]
+        else:
+            bound = min(self.meta["parent"]["overlap"],
+                        self.meta["size"])
+        if obj_logical >= bound:
             return
-        n = min(lay.object_size, overlap - obj_logical)
-        buf = await self._read_parent(obj_logical, n)
+        n = min(lay.object_size, bound - obj_logical)
+        buf = await self._read_below(obj_logical, n)
         if buf.strip(b"\0"):
             try:
-                # through the DATA path: on an encrypted clone the
-                # copied-up parent bytes must be stored as ciphertext,
-                # or the next RMW decrypts plaintext into garbage
-                await self._dio.write(self._data_obj(objectno), buf,
-                                      offset=0)
+                await self._copyup_atomic(self._data_obj(objectno),
+                                          buf)
             except RadosError as e:
                 raise _wrap(e) from e
+
+    async def _copyup_atomic(self, oid: str, buf: bytes) -> None:
+        """Materialize an object from below-data ONLY if still absent
+        (cls rbd copyup): atomic at the OSD, so a migration copier and
+        a live client writer can race -- first creator wins, the other
+        no-ops and never clobbers newer data.  Encrypted images ship
+        the payload pre-encrypted (the cls path bypasses CryptoIoCtx)."""
+        if self._dio is not self.ioctx:
+            buf = self._dio.encrypt_full(oid, buf)
+        await self.ioctx.exec(oid, "rbd", "copyup", bytes(buf))
 
     async def write(self, off: int, data: bytes) -> int:
         if self._no_data_key:
@@ -681,7 +730,8 @@ class Image:
         if off + len(data) > size:
             raise RbdError("EINVAL", "write past end of image")
         lay = self._layout
-        has_parent = bool(self.meta.get("parent"))
+        has_parent = bool(self.meta.get("parent")) \
+            or self._mig_marker is not None
         jseq = None
         if self.journal is not None:
             # journal-safe ordering: the event is durable BEFORE the
@@ -736,7 +786,8 @@ class Image:
             for objectno, _, _ in map_extents(lay0, off, length):
                 self.cacher.discard(self._data_obj(objectno))
         lay = self._layout
-        has_parent = bool(self.meta.get("parent"))
+        has_parent = bool(self.meta.get("parent")) \
+            or self._mig_marker is not None
         jseq = None
         if self.journal is not None:
             jseq = await self.journal.append(
@@ -823,6 +874,12 @@ class Image:
     # -- snapshots -----------------------------------------------------------
     async def create_snap(self, snap_name: str) -> int:
         self._writable_or_raise()
+        if self._mig_marker is not None:
+            # a snap of a half-materialized destination would change
+            # content after commit (holes fall through to the source
+            # HEAD, which then disappears)
+            raise RbdError("EBUSY",
+                           "cannot snapshot a migrating image")
         if self.cacher is not None:
             # the snapshot must contain every write acked before it:
             # cached dirty data lands under the PRE-snap snapc first

@@ -21,6 +21,7 @@ the `ceph tell mgr` analog.
 from __future__ import annotations
 
 import asyncio
+import json
 import time
 
 from ..common import make_task_tracker
@@ -252,6 +253,89 @@ class StatusModule(MgrModule):
         }
 
 
+class DashboardModule(MgrModule):
+    """Read-only web dashboard (src/pybind/mgr/dashboard, compressed
+    to the observability core): an HTTP endpoint on the active mgr
+    serving cluster health, OSD/pool/daemon state as JSON plus a
+    minimal HTML overview -- riding the same hardened HTTP loop as
+    the prometheus exporter."""
+
+    name = "dashboard"
+
+    def __init__(self, mgr: "Mgr") -> None:
+        super().__init__(mgr)
+        self._server = None
+        self.addr: tuple[str, int] | None = None
+
+    async def serve(self) -> None:
+        if not self.mgr.config.get("dashboard_enabled", True):
+            return
+        from .prometheus import MetricsHttpServer
+        self._server = MetricsHttpServer(self._route, router=True)
+        try:
+            self.addr = await self._server.start(
+                port=int(self.mgr.config.get("dashboard_port", 0)))
+        except OSError as e:
+            # an operator must see WHY the dashboard is absent
+            self.mgr.log.append(f"dashboard: bind failed: {e}")
+            return
+        try:
+            await asyncio.Event().wait()       # serve until cancelled
+        except asyncio.CancelledError:
+            await self._server.stop()
+
+    def _payload(self, path: str):
+        m = self.mgr.osdmap
+        if path == "/api/osds":
+            return [{"id": o, "up": i.up, "in": i.in_cluster,
+                     "host": i.host,
+                     "weight": i.weight / 0x10000}
+                    for o, i in sorted(m.osds.items())]
+        if path == "/api/pools":
+            return [{"id": pid, "name": p.name, "type": p.type,
+                     "size": p.size, "pg_num": p.pg_num}
+                    for pid, p in sorted(m.pools.items())]
+        if path == "/api/daemons":
+            return self.mgr.daemon_reports
+        if path in ("/", "/api/summary"):
+            osds = list(m.osds.values())
+            return {"epoch": m.epoch,
+                    "osds": {"total": len(osds),
+                             "up": sum(1 for o in osds if o.up),
+                             "in": sum(1 for o in osds
+                                       if o.in_cluster)},
+                    "pools": len(m.pools),
+                    "daemons": sorted(self.mgr.daemon_reports)}
+        return None
+
+    async def _route(self, path: str):
+        payload = self._payload(path)
+        if payload is None:
+            return "404 Not Found", "text/plain", b"not found"
+        if path == "/":
+            s = payload
+            body = (
+                "<html><head><title>ceph_tpu</title></head><body>"
+                f"<h1>cluster @ epoch {s['epoch']}</h1>"
+                f"<p>OSDs: {s['osds']['up']}/{s['osds']['total']}"
+                f" up, {s['osds']['in']} in</p>"
+                f"<p>pools: {s['pools']}</p>"
+                f"<p>daemons: {', '.join(s['daemons']) or '-'}"
+                "</p><p>JSON: <a href='/api/summary'>summary</a> "
+                "<a href='/api/osds'>osds</a> "
+                "<a href='/api/pools'>pools</a> "
+                "<a href='/api/daemons'>daemons</a></p>"
+                "</body></html>").encode()
+            return "200 OK", "text/html", body
+        return ("200 OK", "application/json",
+                json.dumps(payload).encode())
+
+    async def handle_command(self, cmd: str, args: dict):
+        if cmd == "status":
+            return {"addr": list(self.addr) if self.addr else None}
+        raise ValueError(f"unknown dashboard command {cmd!r}")
+
+
 class TelemetryModule(MgrModule):
     """Anonymized cluster report (src/pybind/mgr/telemetry): opt-in,
     aggregates non-identifying facts -- daemon counts, pool shapes,
@@ -319,7 +403,7 @@ class Mgr:
         self.modules: dict[str, MgrModule] = {}
         for cls in (BalancerModule, PgAutoscalerModule, StatusModule,
                     PrometheusModule, ProgressModule,
-                    TelemetryModule):
+                    TelemetryModule, DashboardModule):
             mod = cls(self)
             self.modules[mod.name] = mod
         self._tasks: list[asyncio.Task] = []

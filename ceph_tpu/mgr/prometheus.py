@@ -65,10 +65,15 @@ def merge_families(*many: dict) -> dict:
 
 
 class MetricsHttpServer:
-    """Tiny GET-only HTTP server for /metrics."""
+    """Tiny hardened GET-only HTTP server (overall request deadline,
+    header-count cap).  ``render`` is either the legacy /metrics
+    coroutine or, with ``router=True``, a ``(path) -> (status,
+    content_type, bytes)`` coroutine -- the dashboard rides the same
+    hardened loop instead of hand-rolling a second one."""
 
-    def __init__(self, render) -> None:
+    def __init__(self, render, router: bool = False) -> None:
         self._render = render
+        self._router = router
         self._server: asyncio.AbstractServer | None = None
         self.addr: tuple[str, int] | None = None
 
@@ -100,7 +105,10 @@ class MetricsHttpServer:
                     break
             path = line.split()[1].decode() if len(line.split()) > 1 \
                 else "/"
-            if path.rstrip("/") in ("", "/metrics".rstrip("/")):
+            if self._router:
+                status, ctype, body = await self._render(
+                    path.split("?")[0])
+            elif path.rstrip("/") in ("", "/metrics".rstrip("/")):
                 body = (await self._render()).encode()
                 status = "200 OK"
                 ctype = "text/plain; version=0.0.4"

@@ -55,6 +55,8 @@ class Gateway:
     def __init__(self, store: RgwStore, region: str = "default") -> None:
         self.store = store
         self.region = region
+        from .swift import SwiftFrontend
+        self.swift = SwiftFrontend(store)
         self._server: asyncio.AbstractServer | None = None
         self.addr: tuple[str, int] | None = None
 
@@ -121,8 +123,9 @@ class Gateway:
                            body)
 
     async def _respond(self, writer, req, status, headers, body):
-        reason = {200: "OK", 204: "No Content", 206: "Partial Content",
-                  400: "Bad Request", 403: "Forbidden",
+        reason = {200: "OK", 201: "Created", 204: "No Content",
+                  206: "Partial Content", 400: "Bad Request",
+                  401: "Unauthorized", 403: "Forbidden",
                   404: "Not Found", 405: "Method Not Allowed",
                   409: "Conflict", 416: "Range Not Satisfiable",
                   500: "Internal Server Error",
@@ -181,6 +184,18 @@ class Gateway:
 
     # -- dispatch ------------------------------------------------------------
     async def _handle(self, req: HttpRequest):
+        if self.swift.routes(req.path):
+            # the Swift dialect shares the store but not the auth or
+            # the XML (rgw serves both APIs from one daemon); its
+            # errors must also surface as HTTP, never a torn socket
+            try:
+                return await self.swift.handle(req)
+            except (ValueError, KeyError) as e:
+                return 400, {"content-type": "text/plain"}, \
+                    f"BadRequest: {type(e).__name__}".encode()
+            except Exception:                 # noqa: BLE001
+                return 500, {"content-type": "text/plain"}, \
+                    b"InternalError"
         try:
             user = await self._authenticate(req)
             parts = req.path.lstrip("/").split("/", 1)

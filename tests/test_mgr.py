@@ -2,6 +2,7 @@
 module host with commands, active balancer loop (src/mgr semantics)."""
 
 import asyncio
+import json
 
 import pytest
 
@@ -181,6 +182,52 @@ def test_config_key_store_and_telemetry():
                 "show", {})
             assert rep["osd"]["count"] == 1
             assert "report_version" in rep
+            await mgr.stop()
+        finally:
+            await teardown(mon, osds)
+    run(main())
+
+
+def test_dashboard_serves_cluster_state():
+    from ceph_tpu.mgr.mgr import Mgr
+
+    async def http_get(addr, path):
+        reader, writer = await asyncio.open_connection(*addr)
+        writer.write(f"GET {path} HTTP/1.1\r\nhost: x\r\n\r\n".encode())
+        await writer.drain()
+        status = int((await reader.readline()).split()[1])
+        hdrs = {}
+        while True:
+            ln = await reader.readline()
+            if ln in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = ln.decode().partition(":")
+            hdrs[k.strip().lower()] = v.strip()
+        body = await reader.readexactly(
+            int(hdrs.get("content-length", "0")))
+        writer.close()
+        return status, body
+
+    async def main():
+        mon, osds = await make_cluster(2)
+        mgr = Mgr(name="d")
+        try:
+            await mgr.start(mon.msgr.addr)
+            for _ in range(50):
+                if mgr.modules["dashboard"].addr:
+                    break
+                await asyncio.sleep(0.1)
+            addr = mgr.modules["dashboard"].addr
+            st, body = await http_get(addr, "/api/summary")
+            assert st == 200
+            s = json.loads(body)
+            assert s["osds"] == {"total": 2, "up": 2, "in": 2}
+            st, body = await http_get(addr, "/api/osds")
+            assert [o["id"] for o in json.loads(body)] == [0, 1]
+            st, body = await http_get(addr, "/")
+            assert st == 200 and b"<h1>cluster" in body
+            st, _ = await http_get(addr, "/api/nope")
+            assert st == 404
             await mgr.stop()
         finally:
             await teardown(mon, osds)

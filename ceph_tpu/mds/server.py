@@ -78,8 +78,12 @@ def _now() -> float:
 class MDS:
     def __init__(self, name: str = "a",
                  meta_pool: str = "cephfs_metadata",
-                 data_pool: str = "cephfs_data") -> None:
+                 data_pool: str = "cephfs_data",
+                 cephx_key: str | None = None) -> None:
         self.name = name
+        # cephx: the MDS's own entity key -- its embedded rados client
+        # must hold OSD tickets when the cluster enforces them
+        self.cephx_key = cephx_key
         self.meta_pool = meta_pool
         self.data_pool = data_pool
         self.msgr = Messenger(f"mds.{name}")
@@ -133,6 +137,9 @@ class MDS:
         self.mon_addr = tuple(mon_addr)
         self.rados = await Rados(mon_addr, name=f"mds.{self.name}"
                                  ).connect()
+        if self.cephx_key:
+            await self.rados.authenticate(f"mds.{self.name}",
+                                          self.cephx_key)
         pools = await self.rados.pool_list()
         if create_pools:
             for p in (self.meta_pool, self.data_pool):

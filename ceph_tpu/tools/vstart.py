@@ -73,8 +73,11 @@ async def run_osd(args) -> None:
     store = _make_store(args, f"osd{args.osd_index}")
     asok = args.asok_dir or args.store_dir
     osd = OSD(host=f"host{args.osd_index % args.hosts}", store=store,
+              whoami=args.osd_index if args.cephx_key else None,
               config={"osd_heartbeat_interval": 0.5,
                       "osd_heartbeat_grace": 4.0},
+              cephx_key=args.cephx_key,
+              require_ticket=bool(args.cephx_key),
               admin_socket_path=(
                   os.path.join(asok, f"osd.{args.osd_index}.asok")
                   if asok else None))
@@ -100,9 +103,22 @@ async def run_cluster(args) -> None:
     osds = []
     for i in range(args.osds):
         store = _make_store(args, f"osd{i}")
+        cephx_key = None
+        if args.cephx:
+            # register the OSD's entity at the mon and boot with
+            # ticket enforcement (clients then need authenticate()).
+            # whoami is pinned to i so the registered entity name
+            # matches the identity the OSD authenticates as even when
+            # a durable mon remembers earlier incarnations
+            rec = await mon.handle_command(
+                "auth get-or-create", {"entity": f"osd.{i}"})
+            cephx_key = rec["key"]
         osd = OSD(host=f"host{i % args.hosts}", store=store,
+                  whoami=i if args.cephx else None,
                   config={"osd_heartbeat_interval": 0.5,
                           "osd_heartbeat_grace": 4.0},
+                  cephx_key=cephx_key,
+                  require_ticket=bool(cephx_key),
                   admin_socket_path=(
                       os.path.join(asok_dir, f"osd.{i}.asok")
                       if asok_dir else None))
@@ -119,10 +135,20 @@ async def run_cluster(args) -> None:
     mdss = []
     for i in range(args.mds):
         from ..mds import MDS
-        m = MDS(name=chr(ord("a") + i))
+        mds_key = None
+        if args.cephx:
+            rec = await mon.handle_command(
+                "auth get-or-create",
+                {"entity": f"mds.{chr(ord('a') + i)}"})
+            mds_key = rec["key"]
+        m = MDS(name=chr(ord("a") + i), cephx_key=mds_key)
         await m.start(addr)
         mdss.append(m)
         print(f"mds.{m.name} up (standby)", flush=True)
+    if args.cephx:
+        print("cephx REQUIRED on the osds: clients must "
+              "`await rados.authenticate(entity, key)` after an "
+              "`auth get-or-create` at the mon", flush=True)
     print(f"cluster ready: 1 mon, {len(osds)} osds"
           f"{', 1 mgr' if mgr else ''}"
           f"{f', {len(mdss)} mds' if mdss else ''} -- "
@@ -167,6 +193,11 @@ def main(argv=None) -> int:
     p.add_argument("--mon-addr", default=None,
                    help="mon address for --role osd (host:port)")
     p.add_argument("--osd-index", type=int, default=0)
+    p.add_argument("--cephx", action="store_true",
+                   help="OSDs enforce cephx tickets (--role all)")
+    p.add_argument("--cephx-key", default=None,
+                   help="--role osd: this daemon's entity key from "
+                        "`auth get-or-create entity=osd.<index>`")
     p.add_argument("--store", choices=("mem", "db", "block", "kv"),
                    default="db",
                    help="store backend when --store-dir is set")
@@ -175,6 +206,9 @@ def main(argv=None) -> int:
         os.makedirs(args.store_dir, exist_ok=True)
     if args.role == "osd" and not args.mon_addr:
         p.error("--role osd requires --mon-addr host:port")
+    if args.cephx and args.role != "all":
+        p.error("--cephx applies to --role all; per-daemon roles "
+                "take --cephx-key (from `auth get-or-create`)")
     runner = {"all": run_cluster, "mon": run_mon,
               "osd": run_osd}[args.role]
     try:

@@ -116,9 +116,13 @@ def _backend_reachable(deadline: float) -> bool:
         if budget < 15:
             return False
         attempt += 1
+        # 150s window: a marginal tunnel's backend init has been
+        # OBSERVED completing in ~80s, just past the old 75s cutoff --
+        # a too-tight window turns a slow-but-alive tunnel into a
+        # zeroed round
         log(f"backend probe attempt {attempt} "
-            f"(window {min(75.0, budget):.0f}s)")
-        if _probe_once(min(75.0, budget)):
+            f"(window {min(150.0, budget):.0f}s)")
+        if _probe_once(min(150.0, budget)):
             return True
         time.sleep(min(20, max(0, deadline - time.monotonic() - 60)))
 

@@ -326,15 +326,31 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 INTERIM = os.path.join(_REPO, "BENCH_interim.json")
 
 
+def _bench_round_no(path: str) -> int:
+    """Parsed integer round number of a BENCH_r*.json path (-1 when
+    unparseable).  Ordering by the raw filename breaks at r100, which
+    would sort before r99 and resurrect an older round's number."""
+    import re
+    m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def _stale_candidates() -> list[tuple[str, str | None]]:
+    """(path, key) fallback candidates, newest first: the interim
+    capture, then committed rounds by DESCENDING round number."""
+    candidates: list[tuple[str, str | None]] = [(INTERIM, None)]
+    import glob
+    for path in sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")),
+                       key=_bench_round_no, reverse=True):
+        candidates.append((path, "parsed"))
+    return candidates
+
+
 def _emit_stale(reason: str) -> bool:
     """Fall back to the most recent committed hardware result, marked
     ``stale`` with its capture provenance.  Returns False if none
     exists (then the caller emits the honest 0.0)."""
-    candidates = [(INTERIM, None)]
-    import glob
-    for path in sorted(glob.glob(
-            os.path.join(_REPO, "BENCH_r*.json")), reverse=True):
-        candidates.append((path, "parsed"))
+    candidates = _stale_candidates()
     for path, key in candidates:
         try:
             with open(path) as f:
@@ -372,12 +388,48 @@ def _save_interim() -> None:
         log(f"interim save failed: {e}")
 
 
+def _osd_path_mode(deadline: float) -> int:
+    """--osd-path: drive the OSD DATA PATH — concurrent client EC
+    writes through an in-process mon+OSD cluster — instead of the raw
+    codec, so the artifact reports what the system achieves (including
+    the CodecBatcher's achieved stripes-per-launch), not just what the
+    kernel could do."""
+    import asyncio
+    from ceph_tpu.tools.ec_osd_bench import run_osd_path_bench
+
+    log("osd-path mode: in-process cluster, concurrent EC writes")
+    res = asyncio.run(run_osd_path_bench(
+        n_osds=int(os.environ.get("BENCH_OSD_N", "3")),
+        k=int(os.environ.get("BENCH_OSD_K", "2")),
+        m=int(os.environ.get("BENCH_OSD_M", "1")),
+        n_objects=int(os.environ.get("BENCH_OSD_OBJECTS", "48")),
+        obj_bytes=int(os.environ.get("BENCH_OSD_OBJ_KIB", "64")) * 1024,
+        concurrency=int(os.environ.get("BENCH_OSD_CONCURRENCY", "16")),
+        batch_max=int(os.environ.get("BENCH_OSD_BATCH", "64")),
+    ))
+    log(f"osd path: {res['osd_path_GiBps']} GiB/s, "
+        f"{res['stripes_per_launch']} stripes/launch "
+        f"({res['batches']} launches)")
+    RESULT.update({
+        "metric": "ec_osd_path_write_GiBps",
+        "value": res["osd_path_GiBps"],
+        "unit": "GiB/s",
+        "vs_baseline": 0.0,
+        **res,
+    })
+    emit()
+    return 0
+
+
 def main() -> int:
     deadline = T0 + float(os.environ.get("BENCH_DEADLINE_S", "270"))
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(int(deadline - T0 + 60))
     threading.Thread(target=_watchdog, args=(deadline,),
                      daemon=True).start()
+
+    if "--osd-path" in sys.argv[1:] or os.environ.get("BENCH_OSD_PATH"):
+        return _osd_path_mode(deadline)
 
     log("probing backend reachability (child process, retry loop)")
     if not _backend_reachable(deadline):

@@ -23,6 +23,7 @@ class PerfCounters:
         self._gauges: dict[str, float] = {}
         self._avgs: dict[str, tuple[float, int]] = {}   # sum, count
         self._hists: dict[str, tuple[list[float], list[int]]] = {}
+        self._hist_sums: dict[str, tuple[float, int]] = {}
 
     def inc(self, key: str, by: int = 1) -> None:
         with self._lock:
@@ -45,11 +46,14 @@ class PerfCounters:
     def hist_register(self, key: str, buckets: list[float]) -> None:
         with self._lock:
             self._hists[key] = (list(buckets), [0] * (len(buckets) + 1))
+            self._hist_sums[key] = (0.0, 0)
 
     def hist_sample(self, key: str, value: float) -> None:
         with self._lock:
             buckets, counts = self._hists[key]
             counts[bisect.bisect_right(buckets, value)] += 1
+            s, c = self._hist_sums[key]
+            self._hist_sums[key] = (s + value, c + 1)
 
     def dump(self) -> dict:
         with self._lock:
@@ -59,7 +63,13 @@ class PerfCounters:
                 out[k] = {"avgcount": c, "sum": s,
                           "avg": (s / c if c else 0.0)}
             for k, (buckets, counts) in self._hists.items():
-                out[k] = {"buckets": buckets, "counts": counts}
+                s, c = self._hist_sums[k]
+                # avg alongside the buckets: a scraper reading mean
+                # occupancy (e.g. stripes-per-batch) should not have
+                # to re-derive it from bucket midpoints
+                out[k] = {"buckets": buckets, "counts": counts,
+                          "count": c, "sum": s,
+                          "avg": (s / c if c else 0.0)}
             return out
 
 

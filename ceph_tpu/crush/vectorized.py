@@ -25,9 +25,14 @@ import numpy as np
 
 import jax
 
-# straw2 draws are 64-bit fixed-point; everything here uses explicit dtypes
-# so the global x64 switch is safe for the rest of the package
-jax.config.update("jax_enable_x64", True)
+# straw2 draws are 64-bit fixed-point, which needs jax's x64 mode -- but
+# flipping the PROCESS-GLOBAL flag at import time would change numeric
+# promotion for every other jax user in an embedding process (importing
+# ceph_tpu must be side-effect free).  The x64 requirement is scoped to
+# the mapper entry points instead via the thread-local enable_x64
+# context (the jit caches key on it, so fused-mapper traces always see
+# x64 while the rest of the package traces unchanged).
+from jax.experimental import enable_x64 as _enable_x64
 
 import jax.numpy as jnp  # noqa: E402
 
@@ -46,7 +51,9 @@ from .types import (
     CRUSH_RULE_SET_CHOOSELEAF_TRIES,
 )
 
-S64_MIN = jnp.int64(-(2**63))
+# numpy constant: materializing a jnp.int64 here would require x64 at
+# import time (exactly what this module must not demand)
+S64_MIN = np.int64(-(2**63))
 CRUSH_HASH_SEED = np.uint32(1315423911)
 
 
@@ -92,12 +99,18 @@ def hash32_3_jnp(a, b, c):
     return h
 
 
-_RH_LH = jnp.asarray(RH_LH_TBL)   # int64 (258,)
-_LL = jnp.asarray(LL_TBL)         # int64 (256,)
+# keep the int64 log tables as NUMPY at module scope: a jnp.asarray
+# here would run outside the enable_x64 scope and silently truncate to
+# int32.  They become trace-time constants inside crush_ln_jnp, which
+# only ever traces under x64.
+_RH_LH_NP = np.asarray(RH_LH_TBL, np.int64)   # (258,)
+_LL_NP = np.asarray(LL_TBL, np.int64)         # (256,)
 
 
 def crush_ln_jnp(u):
     """Vector crush_ln over int32 u in [0, 0xffff] -> int64."""
+    _RH_LH = jnp.asarray(_RH_LH_NP)
+    _LL = jnp.asarray(_LL_NP)
     x = u.astype(jnp.int64) + 1
     need = (x & 0x18000) == 0
     masked = (x & 0x1FFFF).astype(jnp.int32)
@@ -480,8 +493,9 @@ class VectorCrush:
         return jnp.where(out_o == UNDEF, CRUSH_ITEM_NONE, out_o)
 
     def map_pgs(self, xs, numrep: int, osd_weights) -> np.ndarray:
-        xs = jnp.asarray(xs, jnp.int32)
-        w = jnp.asarray(osd_weights, jnp.int32)
-        if self.firstn:
-            return np.asarray(self.map_firstn(xs, numrep, w))
-        return np.asarray(self.map_indep(xs, numrep, w))
+        with _enable_x64():
+            xs = jnp.asarray(xs, jnp.int32)
+            w = jnp.asarray(osd_weights, jnp.int32)
+            if self.firstn:
+                return np.asarray(self.map_firstn(xs, numrep, w))
+            return np.asarray(self.map_indep(xs, numrep, w))

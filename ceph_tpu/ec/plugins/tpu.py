@@ -22,11 +22,21 @@ class ErasureCodeTpu(ErasureCodeIsa):
     def __init__(self, technique: str = K_VANDERMONDE) -> None:
         super().__init__(technique=technique, backend=JaxBackend())
 
-    # -- batched entry points (bench / ECBackend fast path) -----------------
+    # -- batched entry points (OSD CodecBatcher / bench fast path) ----------
     def encode_batch(self, data: np.ndarray, out_np: bool = False):
         """(B, k, L) data chunks -> (B, m, L) parity chunks, one launch."""
         return self.backend.matmul_batch(
             self.encode_matrix[self.k:], data, out_np=out_np)
+
+    def decode_signature(self, erasures) -> str:
+        """DecodeTableCache key for an erasure pattern.  Also the
+        grouping key the per-OSD CodecBatcher uses to decide which
+        reconstruction submissions may share a decode_batch launch
+        (same signature = same decode matrix = same math)."""
+        from ...gf import erasure_signature
+        from ...gf.matrices import decode_index_for
+        return erasure_signature(
+            decode_index_for(self.k, set(erasures)), list(erasures))
 
     def decode_batch(self, erasures: list[int], chunks: np.ndarray,
                      out_np: bool = False):
@@ -35,15 +45,12 @@ class ErasureCodeTpu(ErasureCodeIsa):
         ``chunks`` is (B, k, L): for every stripe, the k surviving chunks in
         decode_index order (first k surviving shard ids ascending).
         """
-        from ...gf import build_decode_matrix, erasure_signature
-        from ...gf.matrices import decode_index_for
-        k = self.k
-        signature = erasure_signature(
-            decode_index_for(k, set(erasures)), list(erasures))
+        from ...gf import build_decode_matrix
+        signature = self.decode_signature(erasures)
         entry = self.tcache.get(signature)
         if entry is None:
             matrix, decode_index = build_decode_matrix(
-                self.encode_matrix, k, list(erasures))
+                self.encode_matrix, self.k, list(erasures))
             self.tcache.put(signature, matrix, decode_index)
         else:
             matrix, decode_index = entry

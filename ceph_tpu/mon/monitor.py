@@ -1132,8 +1132,15 @@ class Monitor:
             profile = dict(args.get("profile", {}))
             # validate by instantiating the plugin
             plugin = profile.get("plugin", "tpu")
-            ec_registry().factory(plugin, {k: v for k, v in profile.items()
-                                           if k != "plugin"})
+            codec = ec_registry().factory(
+                plugin, {k: v for k, v in profile.items()
+                         if k != "plugin"})
+            if "stripe_unit" in profile:
+                # prepare_pool_stripe_width analog (OSDMonitor.cc:7782):
+                # reject unaligned/zero/garbage stripe units HERE, not
+                # at first I/O on some OSD
+                from ..osd.ec_util import parse_stripe_unit
+                parse_stripe_unit(codec, profile["stripe_unit"])
             inc = Incremental(epoch=0)
             inc.new_ec_profiles[name] = profile
             await self.propose(inc)
@@ -1255,6 +1262,11 @@ class Monitor:
             codec = ec_registry().factory(
                 profile.get("plugin", "tpu"),
                 {pk: pv for pk, pv in profile.items() if pk != "plugin"})
+            if "stripe_unit" in profile:
+                # pool creation is the last gate before the profile's
+                # stripe geometry becomes I/O-visible
+                from ..osd.ec_util import parse_stripe_unit
+                parse_stripe_unit(codec, profile["stripe_unit"])
             width = codec.get_chunk_count()
             k = codec.get_data_chunk_count()
             spec = PoolSpec(pool_id=pool_id, name=name,

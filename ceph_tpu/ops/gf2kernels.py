@@ -36,6 +36,23 @@ from ..gf.gf8 import matrix_to_bitmatrix
 LANE_TILE = 8192
 
 
+def bucket_batch(b: int) -> int:
+    """Round a batch dimension up to a power of two.
+
+    The batch kernels compile per (B, k, L); a coalescing caller (the
+    OSD CodecBatcher) produces near-arbitrary B values, which would
+    churn the jit cache with single-use executables.  Zero-padding the
+    batch axis to the bucket is byte-exact (GF matmul rows are
+    independent) and bounds distinct shapes to log2(max_batch).
+    """
+    if b <= 1:
+        return 1
+    n = 1
+    while n < b:
+        n *= 2
+    return n
+
+
 @functools.lru_cache(maxsize=256)
 def _bitmatrix_cached(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
     mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)

@@ -1,0 +1,243 @@
+"""Per-OSD cross-PG EC codec micro-batching.
+
+The whole thesis of the TPU plugin is that erasure-code math amortizes
+when many stripes share one MXU launch (ceph_tpu/ops/gf2kernels.py),
+but the OSD data path naturally produces work one op at a time: each
+ECBackend write re-encodes its own stripe run, each reconstruction
+decodes its own object.  Dispatched per op, every launch pays the
+device round trip and the batch dimension stays 1 -- slower than the
+host path for small writes.
+
+The CodecBatcher is the aggregation stage in between: every ECBackend
+on an OSD (across ALL its PGs) submits encode/decode work here, the
+batcher coalesces stripe sets from concurrently in-flight ops into
+single ``encode_batch`` / ``decode_batch`` launches, and fans results
+back to per-op futures byte-identically.  The role analog in the
+reference is the RMW pipelining of src/osd/ECCommon.cc:704-789 --
+there the overhead amortized is the read-modify-write round trip, here
+it is the accelerator launch.
+
+Mechanics:
+
+  * submissions are grouped by codec *profile signature* (the encode
+    matrix bytes + (k, m), plus the erasure pattern for decodes --
+    the same keying as the DecodeTableCache) so stripes from
+    different PGs, even different pools with the same profile, share
+    a launch;
+  * ragged tails are padded to a common (B, k, L): the GF matmul is
+    column-independent, so zero-padding the lane axis and slicing the
+    result back is byte-exact, and the batch axis is rounded up to a
+    power-of-two bucket so the jit cache stays bounded
+    (gf2kernels.bucket_batch);
+  * a group flushes when it reaches ``max_batch`` stripes, when the
+    event loop completes a pass with no new submissions (the Nagle-off
+    fast path: nothing else is going to coalesce, launch now), or on a
+    short timer backstop;
+  * codecs without batch entry points (isa/jerasure host plugins,
+    layered codes with chunk remapping) fall back transparently to the
+    per-op path -- ``supports`` gates at the call site.
+
+Occupancy is surfaced as perf counters (``perf dump`` -> "ec_batch"):
+batches launched, a stripes-per-batch histogram, padding waste, and
+flush-reason counts, so the bench can report achieved batch sizes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+STRIPE_HIST_BUCKETS = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                       256.0, 512.0]
+
+
+def codec_signature(codec, kind: str, extra: tuple) -> tuple:
+    """Launch-compatibility key: submissions with the same signature
+    compute with the same coefficient matrix and may share a batch.
+    Decode submissions fold in the DecodeTableCache signature (the
+    erasure pattern picks the decode matrix)."""
+    if kind == "decode" and hasattr(codec, "decode_signature"):
+        extra = (codec.decode_signature(extra),) + extra
+    return (kind, codec.k, codec.m,
+            codec.encode_matrix.tobytes(), extra)
+
+
+class _Group:
+    """One pending batch: submissions awaiting a shared launch."""
+
+    __slots__ = ("codec", "kind", "extra", "items", "n_stripes", "task")
+
+    def __init__(self, codec, kind: str, extra: tuple) -> None:
+        self.codec = codec
+        self.kind = kind                 # "encode" | "decode"
+        self.extra = extra               # decode: erasure tuple
+        self.items: list[tuple[np.ndarray, asyncio.Future]] = []
+        self.n_stripes = 0
+        self.task: asyncio.Task | None = None
+
+
+class CodecBatcher:
+    """Asyncio micro-batching stage for EC codec launches.
+
+    ``await encode(codec, stripes)`` with stripes shaped (n, k, L)
+    resolves to the (n, m, L) parity chunks; ``await decode(codec,
+    erasures, survivors)`` with survivors shaped (n, k, L) in
+    decode-index order resolves to the (n, len(erasures), L) recovered
+    chunks.  Results are byte-identical to per-stripe codec.encode /
+    codec.decode.
+    """
+
+    def __init__(self, *, max_batch: int = 64,
+                 flush_timeout: float = 0.002,
+                 eager_flush: bool = True, perf=None) -> None:
+        self.max_batch = max(1, int(max_batch))
+        self.flush_timeout = float(flush_timeout)
+        self.eager_flush = bool(eager_flush)
+        self.perf = perf
+        self._groups: dict[tuple, _Group] = {}
+        self._closed = False
+        if perf is not None:
+            perf.hist_register("stripes_per_batch", STRIPE_HIST_BUCKETS)
+
+    # -- capability gate ----------------------------------------------------
+    @staticmethod
+    def supports(codec) -> bool:
+        """Batched entry points exist and the chunk layout is the plain
+        positional one (a chunk remapping would decouple shard ids from
+        matrix rows, which the batch kernels do not model)."""
+        return (hasattr(codec, "encode_batch")
+                and hasattr(codec, "decode_batch")
+                and getattr(codec, "encode_matrix", None) is not None
+                and not codec.get_chunk_mapping())
+
+    # -- submission ---------------------------------------------------------
+    async def encode(self, codec, stripes: np.ndarray) -> np.ndarray:
+        """(n, k, L) data chunks -> (n, m, L) parity chunks."""
+        return await self._submit("encode", codec, stripes, ())
+
+    async def decode(self, codec, erasures: tuple[int, ...],
+                     survivors: np.ndarray) -> np.ndarray:
+        """(n, k, L) surviving chunks (decode-index order, the same
+        contract as ``decode_batch``) -> (n, len(erasures), L)."""
+        return await self._submit("decode", codec, survivors,
+                                  tuple(int(e) for e in erasures))
+
+    def note_fallback(self) -> None:
+        """A caller took the per-op path for a non-batch codec."""
+        if self.perf is not None:
+            self.perf.inc("fallback_ops")
+
+    async def _submit(self, kind: str, codec, arr: np.ndarray,
+                      extra: tuple) -> np.ndarray:
+        arr = np.ascontiguousarray(arr, dtype=np.uint8)
+        assert arr.ndim == 3, arr.shape
+        if self._closed:
+            # late stragglers during shutdown: launch solo
+            return self._launch_one(kind, codec, extra, arr)
+        key = codec_signature(codec, kind, extra)
+        grp = self._groups.get(key)
+        if grp is None:
+            grp = self._groups[key] = _Group(codec, kind, extra)
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        grp.items.append((arr, fut))
+        grp.n_stripes += arr.shape[0]
+        if grp.n_stripes >= self.max_batch:
+            self._flush(key, "full")
+        elif grp.task is None:
+            grp.task = loop.create_task(self._linger(key, grp))
+        return await fut
+
+    # -- flush policy --------------------------------------------------------
+    async def _linger(self, key: tuple, grp: _Group) -> None:
+        """Wait for co-submitters, then flush.  The group grows while
+        other runnable tasks reach their submit points; one full event
+        loop pass with no growth means the queue drained."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + self.flush_timeout
+        try:
+            while True:
+                n0 = grp.n_stripes
+                await asyncio.sleep(0)
+                if self._groups.get(key) is not grp:
+                    return               # flushed by the size threshold
+                if grp.n_stripes != n0:
+                    continue             # still coalescing
+                if self.eager_flush:
+                    self._flush(key, "drain")
+                    return
+                now = loop.time()
+                if now >= deadline:
+                    self._flush(key, "timer")
+                    return
+                await asyncio.sleep(min(self.flush_timeout / 4,
+                                        deadline - now))
+        except asyncio.CancelledError:
+            pass
+
+    def _flush(self, key: tuple, reason: str) -> None:
+        grp = self._groups.pop(key, None)
+        if grp is None or not grp.items:
+            return
+        self._run_batch(grp, reason)
+
+    def flush_all(self, reason: str = "close") -> None:
+        for key in list(self._groups):
+            self._flush(key, reason)
+
+    def close(self) -> None:
+        """Launch whatever is pending so in-flight ops complete, then
+        refuse further coalescing (stragglers launch solo)."""
+        self._closed = True
+        self.flush_all("close")
+
+    # -- the launch ----------------------------------------------------------
+    def _launch_one(self, kind: str, codec, extra: tuple,
+                    arr: np.ndarray):
+        if kind == "encode":
+            return np.asarray(codec.encode_batch(arr, out_np=True))
+        return np.asarray(codec.decode_batch(list(extra), arr,
+                                             out_np=True))
+
+    def _run_batch(self, grp: _Group, reason: str) -> None:
+        # lazy: gf2kernels pulls in jax, which a replicated-only OSD
+        # must not pay for at boot (only EC submissions reach here,
+        # and by then the codec itself has loaded the stack)
+        from ..ops.gf2kernels import bucket_batch
+        items = grp.items
+        k = items[0][0].shape[1]
+        lane = max(a.shape[2] for a, _ in items)
+        total = sum(a.shape[0] for a, _ in items)
+        b = bucket_batch(total)
+        payload = sum(a.size for a, _ in items)
+        if len(items) == 1 and b == total:
+            batch = items[0][0]
+        else:
+            batch = np.zeros((b, k, lane), np.uint8)
+            row = 0
+            for a, _ in items:
+                n, _, l = a.shape
+                batch[row:row + n, :, :l] = a
+                row += n
+        try:
+            out = self._launch_one(grp.kind, grp.codec, grp.extra, batch)
+        except Exception as e:
+            for _, fut in items:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        row = 0
+        for a, fut in items:
+            n, _, l = a.shape
+            if not fut.done():
+                fut.set_result(out[row:row + n, :, :l])
+            row += n
+        if self.perf is not None:
+            self.perf.inc("batches")
+            self.perf.inc(f"{grp.kind}_launches")
+            self.perf.inc("stripes", total)
+            self.perf.inc("ops_coalesced", len(items))
+            self.perf.inc("pad_waste_bytes", b * k * lane - payload)
+            self.perf.inc(f"flush_{reason}")
+            self.perf.hist_sample("stripes_per_batch", total)

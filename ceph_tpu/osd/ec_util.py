@@ -14,6 +14,29 @@ from typing import Mapping
 import numpy as np
 
 
+def parse_stripe_unit(codec, value) -> int:
+    """Validate a profile's stripe_unit (OSDMonitor.cc:7782-7813
+    prepare_pool_stripe_width mirror): it must parse as a positive
+    integer and divide evenly into codec-aligned chunks, or the pool's
+    stripe geometry silently diverges from what the profile claims.
+    Raises ValueError with the reference's spirit of message.
+    """
+    try:
+        su = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"stripe_unit {value!r} is not an integer") from None
+    if su <= 0:
+        raise ValueError(f"stripe_unit {su} must be > 0")
+    align = codec.get_alignment()
+    if su % align:
+        raise ValueError(
+            f"stripe_unit {su} must be a multiple of the codec "
+            f"alignment {align} (the codec would round chunks up and "
+            f"desync the stripe geometry)")
+    return su
+
+
 class StripeInfo:
     def __init__(self, k: int, m: int, stripe_width: int) -> None:
         assert stripe_width % k == 0, (stripe_width, k)
@@ -96,6 +119,93 @@ class StripeInfo:
                     else np.zeros(0, np.uint8))
                 for i, bufs in shards.items()}
 
+    async def encode_async(self, codec, data: bytes,
+                           batcher=None) -> dict[int, np.ndarray]:
+        """Batched analog of encode(): every stripe of ``data`` rides
+        ONE ``encode_batch`` launch, and with a CodecBatcher the launch
+        is shared with other concurrently-submitting ops (cross-PG
+        coalescing).  Byte-identical to encode(); codecs without batch
+        entry points fall back transparently."""
+        from .codec_batcher import CodecBatcher
+        if batcher is None or not CodecBatcher.supports(codec):
+            if batcher is not None:
+                batcher.note_fallback()
+            return self.encode(codec, data)
+        self._check_codec(codec)
+        assert len(data) % self.stripe_width == 0, len(data)
+        n = len(data) // self.stripe_width
+        if n == 0:
+            return {i: np.zeros(0, np.uint8)
+                    for i in range(self.k + self.m)}
+        arr = np.frombuffer(data, np.uint8).reshape(
+            n, self.k, self.chunk_size)
+        parity = await batcher.encode(codec, arr)
+        out: dict[int, np.ndarray] = {}
+        for i in range(self.k):
+            out[i] = np.ascontiguousarray(arr[:, i]).reshape(-1)
+        for r in range(self.m):
+            out[self.k + r] = np.ascontiguousarray(
+                parity[:, r]).reshape(-1)
+        return out
+
+    async def decode_async(self, codec,
+                           shard_bufs: Mapping[int, np.ndarray],
+                           want: set[int] | None = None,
+                           batcher=None) -> dict[int, np.ndarray]:
+        """Batched analog of decode(): all stripes' reconstructions in
+        one ``decode_batch`` launch, grouped in the batcher by erasure
+        signature (the DecodeTableCache keying) so concurrent recovery
+        reads with the same down-shard pattern coalesce."""
+        from .codec_batcher import CodecBatcher
+        from ..gf.matrices import decode_index_for
+        want = (set(self.data_positions(codec)) if want is None
+                else set(want))
+        have = set(shard_bufs)
+        k, m = self.k, self.m
+        erasures = sorted(i for i in range(k + m) if i not in have)
+        if batcher is None or not CodecBatcher.supports(codec):
+            if batcher is not None:
+                batcher.note_fallback()
+            return self.decode(codec, shard_bufs, want)
+        self._check_codec(codec)
+        lens = {len(b) for b in shard_bufs.values()}
+        assert len(lens) == 1, lens
+        shard_len = lens.pop()
+        assert shard_len % self.chunk_size == 0, shard_len
+        n = shard_len // self.chunk_size
+        cs = self.chunk_size
+        if n == 0:
+            return {i: np.zeros(0, np.uint8) for i in want}
+        if want <= have or not erasures:
+            return {i: np.asarray(shard_bufs[i], dtype=np.uint8)
+                    for i in want}
+        if len(erasures) > m or len(have) < k:
+            # unrecoverable: let the per-stripe driver raise its
+            # canonical IOError
+            return self.decode(codec, shard_bufs, want)
+        decode_index = decode_index_for(k, set(erasures))
+        survivors = np.stack(
+            [np.asarray(shard_bufs[i], dtype=np.uint8).reshape(n, cs)
+             for i in decode_index], axis=1)          # (n, k, cs)
+        rec = await batcher.decode(codec, tuple(erasures), survivors)
+        out: dict[int, np.ndarray] = {}
+        for i in want:
+            if i in shard_bufs:
+                out[i] = np.asarray(shard_bufs[i], dtype=np.uint8)
+            else:
+                out[i] = np.ascontiguousarray(
+                    rec[:, erasures.index(i)]).reshape(-1)
+        return out
+
+    async def reconstruct_logical_async(
+            self, codec, shard_bufs: Mapping[int, np.ndarray],
+            batcher=None) -> bytes:
+        dpos = self.data_positions(codec)
+        data_shards = await self.decode_async(codec, shard_bufs,
+                                              want=set(dpos),
+                                              batcher=batcher)
+        return self._interleave_logical(codec, data_shards)
+
     @staticmethod
     def data_positions(codec) -> list[int]:
         """Shard ids hosting data chunks 0..k-1 (mapped codes like lrc
@@ -136,6 +246,11 @@ class StripeInfo:
         """Rebuild the logical byte stream from shard buffers."""
         dpos = self.data_positions(codec)
         data_shards = self.decode(codec, shard_bufs, want=set(dpos))
+        return self._interleave_logical(codec, data_shards)
+
+    def _interleave_logical(self, codec,
+                            data_shards: Mapping[int, np.ndarray]) -> bytes:
+        dpos = self.data_positions(codec)
         shard_len = len(next(iter(data_shards.values())))
         n_stripes = shard_len // self.chunk_size
         parts = []

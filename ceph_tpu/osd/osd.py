@@ -118,6 +118,21 @@ class OSD:
         # observability (src/common/perf_counters + TrackedOp analog)
         self.perf = PerfCountersCollection()
         self.perf_osd = self.perf.create("osd")
+        # cross-PG EC codec aggregation stage: every ECBackend on this
+        # OSD funnels encode/decode work through ONE batcher so
+        # concurrent ops share accelerator launches
+        # (ceph_tpu/osd/codec_batcher.py)
+        from .codec_batcher import CodecBatcher
+        if self.config.get("osd_ec_batch_enabled", True):
+            self.codec_batcher = CodecBatcher(
+                max_batch=int(self.config.get("osd_ec_batch_max", 64)),
+                flush_timeout=float(
+                    self.config.get("osd_ec_batch_timeout", 0.002)),
+                eager_flush=bool(
+                    self.config.get("osd_ec_batch_eager_flush", True)),
+                perf=self.perf.create("ec_batch"))
+        else:
+            self.codec_batcher = None
         self._notify_serial = itertools.count(1)
         self._notify_waiters: dict[str, asyncio.Future] = {}
         # TrackedOp/OpTracker (src/common/TrackedOp.h): in-flight op
@@ -273,6 +288,8 @@ class OSD:
 
     async def stop(self) -> None:
         self._stopped = True
+        if self.codec_batcher is not None:
+            self.codec_batcher.close()
         if self.admin_socket is not None:
             await self.admin_socket.stop()
         for t in list(self._tasks):

@@ -1,0 +1,48 @@
+"""Bench harness regressions (ADVICE round 5 / VERDICT next-round).
+
+* the stale-fallback candidate order must follow PARSED round numbers
+  (reverse-lexicographic filenames break at r100: "r100" < "r99");
+* importing ceph_tpu must not flip process-global JAX precision
+  (jax_enable_x64 stays scoped to the fused CRUSH entry points).
+"""
+
+import importlib
+import sys
+
+
+def _bench():
+    sys.path.insert(0, ".")
+    import bench
+    return importlib.reload(bench)
+
+
+def test_stale_candidates_sort_by_parsed_round_number(tmp_path,
+                                                      monkeypatch):
+    bench = _bench()
+    for r in (1, 2, 9, 10, 99, 100, 101):
+        (tmp_path / f"BENCH_r{r:02d}.json").write_text("{}") \
+            if r < 10 else \
+            (tmp_path / f"BENCH_r{r}.json").write_text("{}")
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    cands = bench._stale_candidates()
+    rounds = [bench._bench_round_no(p) for p, key in cands
+              if key == "parsed"]
+    # newest committed round FIRST -- r101 beats r99 even though
+    # "BENCH_r101.json" < "BENCH_r99.json" lexicographically
+    assert rounds == sorted(rounds, reverse=True)
+    assert rounds[0] == 101
+    # the interim capture stays ahead of every committed round
+    assert cands[0][1] is None
+
+
+def test_bench_round_no_parses_and_rejects():
+    bench = _bench()
+    assert bench._bench_round_no("/x/BENCH_r07.json") == 7
+    assert bench._bench_round_no("/x/BENCH_r123.json") == 123
+    assert bench._bench_round_no("/x/BENCH_interim.json") == -1
+
+
+def test_import_does_not_flip_global_x64():
+    import jax
+    import ceph_tpu.crush.vectorized  # noqa: F401 -- the old offender
+    assert jax.config.jax_enable_x64 is False

@@ -207,6 +207,40 @@ def test_extent_cache_feeds_repeat_overwrites():
     run(main())
 
 
+def test_docstring_matches_rmw_write_amplification():
+    """The ECBackend docstring once claimed partial-stripe overwrite
+    was future work and every write rewrote the stripe set; RMW with
+    ranged sub-writes landed long ago.  Pin BOTH: the prose must state
+    the O(touched stripes) behavior, and the data path must honor it
+    with EXACT per-shard byte accounting (one chunk per touched stripe
+    per remote shard, not the whole object)."""
+    from ceph_tpu.osd.backend import ECBackend
+    doc = ECBackend.__doc__
+    assert "future work" not in doc
+    assert "O(touched stripes)" in doc
+
+    async def main():
+        c = await _ec_cluster()
+        try:
+            # 10 stripes (stripe_width 8192, chunk 4096)
+            big = np.random.default_rng(11).integers(
+                0, 256, 10 * 8192, dtype=np.uint8).tobytes()
+            await c.osd_op("ecpool", "amp", [
+                {"op": "writefull", "data": big}])
+            pgid, _, _ = c.target_for("ecpool", "amp")
+            counts = _spy_subop_bytes(c, pgid)
+            # overwrite entirely inside stripe 4: exactly ONE stripe
+            # touched -> each of the 2 remote shards gets exactly one
+            # 4096-byte chunk
+            await c.osd_op("ecpool", "amp", [
+                {"op": "write", "off": 4 * 8192 + 100, "data": b"Q" * 500}])
+            assert counts["calls"] == 2, counts
+            assert counts["bytes"] == 2 * 4096, counts
+        finally:
+            await c.stop()
+    run(main())
+
+
 def test_zero_of_region_extended_in_same_vector():
     """A zero clamping against stale old_size instead of the running
     size silently dropped the zero (review regression)."""

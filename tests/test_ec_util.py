@@ -35,6 +35,30 @@ def test_offset_maps():
     assert s.offset_len_to_stripe_bounds(0, 257) == (0, 512)
 
 
+def test_parse_stripe_unit_validation(codec):
+    """prepare_pool_stripe_width analog: garbage, zero/negative and
+    codec-unaligned stripe units are rejected; sane ones (including
+    string-typed profile values) parse."""
+    from ceph_tpu.osd.ec_util import parse_stripe_unit
+    assert parse_stripe_unit(codec, 4096) == 4096
+    assert parse_stripe_unit(codec, "8192") == 8192
+    assert parse_stripe_unit(codec, 32) == 32      # = alignment
+    for bad in (0, -1, -4096, "xyz", None, "3.5", 100):
+        with pytest.raises(ValueError):
+            parse_stripe_unit(codec, bad)
+
+
+def test_ecbackend_profile_stripe_unit_rejected():
+    """ECBackend must refuse a garbage stripe_unit instead of silently
+    mis-striping (the old code accepted anything int() swallowed)."""
+    from ceph_tpu.osd.ec_util import parse_stripe_unit
+    tpu = ec_registry().factory("tpu", {"k": "2", "m": "1"})
+    with pytest.raises(ValueError):
+        parse_stripe_unit(tpu, 1000)               # not 32-aligned
+    with pytest.raises(ValueError):
+        parse_stripe_unit(tpu, "4k")               # iec strings: no
+
+
 def test_stripe_encode_decode_roundtrip(codec):
     s = StripeInfo.for_codec(codec, stripe_unit=64)
     rng = np.random.default_rng(7)

@@ -8,6 +8,7 @@ ring-buffer logger (src/log/Log.cc).
 
 from .config import Option, ConfigProxy, OPT_INT, OPT_FLOAT, OPT_STR, \
     OPT_BOOL
+from .faults import FaultDecision, FaultRule, MessageFaultInjector
 from .perf import PerfCounters, PerfCountersCollection
 from .admin_socket import AdminSocket
 from .log import Logger, log_context
@@ -33,5 +34,6 @@ def make_task_tracker(tasks: list):
 __all__ = [
     "Option", "ConfigProxy", "OPT_INT", "OPT_FLOAT", "OPT_STR",
     "OPT_BOOL", "PerfCounters", "PerfCountersCollection", "AdminSocket",
-    "Logger", "log_context", "make_task_tracker",
+    "Logger", "log_context", "make_task_tracker", "FaultDecision",
+    "FaultRule", "MessageFaultInjector",
 ]

@@ -87,6 +87,25 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_pool_default_erasure_code_profile", OPT_STR,
            "plugin=tpu k=2 m=1 technique=reed_sol_van",
            "default EC profile"),
+    Option("osd_peering_retry_base", OPT_FLOAT, 0.5,
+           "initial peering retry delay (doubles per attempt)",
+           min=0.01),
+    Option("osd_peering_retry_max", OPT_FLOAT, 8.0,
+           "peering retry backoff ceiling in seconds", min=0.01),
+    Option("osd_peering_retry_jitter", OPT_FLOAT, 0.25,
+           "fraction of the delay randomized to de-synchronize "
+           "retrying primaries", min=0.0, max=1.0),
+    Option("osd_wait_acting_change_timeout", OPT_FLOAT, 10.0,
+           "seconds to hold peering for a requested pg_temp override "
+           "before serving the interval ourselves", min=0.1),
+    Option("osd_ec_read_timeout", OPT_FLOAT, 5.0,
+           "per-attempt deadline for an EC shard fetch fanout",
+           min=0.1),
+    Option("osd_ec_read_retries", OPT_INT, 3,
+           "extra rounds a degraded shard gather may retry failed "
+           "sources before erroring the read", min=0),
+    Option("osd_ec_read_backoff", OPT_FLOAT, 0.25,
+           "base backoff between shard-gather retry rounds", min=0.0),
     Option("debug_osd", OPT_INT, 1, "osd log verbosity", min=0, max=20,
            level=LEVEL_DEV),
     Option("debug_mon", OPT_INT, 1, "mon log verbosity", min=0, max=20,

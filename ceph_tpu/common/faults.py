@@ -1,0 +1,179 @@
+"""Deterministic cluster-level fault injection for the messenger.
+
+Where common/throttle.py's FaultInjector arms named SITES inside one
+process (EIO on a store read, a socket drop mid-send), this module
+injects at the MESSAGE level between daemons: drop, delay or duplicate
+messages matched by peer name and/or message type, and partition whole
+name groups from each other -- the qa/tasks thrasher's network side
+(mon_thrash / msgr-failures) in library form.
+
+Determinism is the point: every decision is drawn from ONE seeded RNG
+in message-arrival order, so a chaos run that found a bug replays the
+same drop/delay schedule from the same seed (fault_injector.h keeps
+its injection deterministic for the same reason).  tools/chaos.py
+drives clusters with one of these per daemon; tests pin the
+schedule-reproducibility in tests/test_fault_injection.py.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+
+SEND = "send"
+RECV = "recv"
+BOTH = "both"
+
+
+def _match_name(pattern: str | None, name: str) -> bool:
+    """None matches everything; "osd." prefix-matches every OSD;
+    "osd.3" matches exactly (prefix match would alias osd.30)."""
+    if pattern is None:
+        return True
+    if pattern.endswith("."):
+        return name.startswith(pattern)
+    return name == pattern
+
+
+@dataclass
+class FaultRule:
+    """One armed fault: `action` on messages matching peer/mtype."""
+
+    action: str                      # "drop" | "delay" | "dup"
+    peer: str | None = None          # peer name or "svc." prefix
+    mtype: str | None = None         # message type, None = any
+    direction: str = BOTH            # send / recv / both
+    probability: float = 1.0
+    count: int | None = None         # remaining firings; None = forever
+    delay: float = 0.05              # seconds, for "delay"
+    fired: int = 0
+
+    def matches(self, direction: str, peer: str, mtype: str) -> bool:
+        if self.count is not None and self.count <= 0:
+            return False
+        if self.direction != BOTH and self.direction != direction:
+            return False
+        return _match_name(self.peer, peer) and (
+            self.mtype is None or self.mtype == mtype)
+
+
+@dataclass
+class FaultDecision:
+    drop: bool = False
+    delay: float = 0.0
+    copies: int = 1                  # >1 = duplicate delivery
+
+
+class MessageFaultInjector:
+    """Seeded, rule-driven message mangling for one endpoint.
+
+    One instance is threaded into a Messenger (and from there consulted
+    on every app-level send and every delivered message).  All
+    endpoints of a test cluster may share one instance -- decisions
+    stay deterministic because the event loop serializes the calls.
+    """
+
+    def __init__(self, seed: int = 0, perf=None) -> None:
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.rules: list[FaultRule] = []
+        # symmetric partitions: (group_a, group_b) name patterns
+        self.partitions: list[tuple[str, str]] = []
+        self.stats: dict[str, int] = {}
+        self.perf = perf             # optional PerfCounters sink
+
+    # -- arming --------------------------------------------------------------
+    def add_rule(self, rule: FaultRule) -> FaultRule:
+        self.rules.append(rule)
+        return rule
+
+    def drop(self, *, peer: str | None = None, mtype: str | None = None,
+             direction: str = BOTH, probability: float = 1.0,
+             count: int | None = None) -> FaultRule:
+        return self.add_rule(FaultRule("drop", peer, mtype, direction,
+                                       probability, count))
+
+    def delay(self, seconds: float, *, peer: str | None = None,
+              mtype: str | None = None, direction: str = BOTH,
+              probability: float = 1.0,
+              count: int | None = None) -> FaultRule:
+        return self.add_rule(FaultRule("delay", peer, mtype, direction,
+                                       probability, count,
+                                       delay=seconds))
+
+    def duplicate(self, *, peer: str | None = None,
+                  mtype: str | None = None, direction: str = BOTH,
+                  probability: float = 1.0,
+                  count: int | None = None) -> FaultRule:
+        return self.add_rule(FaultRule("dup", peer, mtype, direction,
+                                       probability, count))
+
+    def partition(self, a: str, b: str) -> None:
+        """Drop EVERYTHING between name groups a and b (both
+        directions; "osd." partitions every OSD from b)."""
+        self.partitions.append((a, b))
+
+    def heal(self, a: str | None = None, b: str | None = None) -> None:
+        """Remove partitions (all of them when called bare)."""
+        if a is None:
+            self.partitions.clear()
+        else:
+            self.partitions = [p for p in self.partitions
+                               if p != (a, b) and p != (b, a)]
+
+    def clear(self) -> None:
+        self.rules.clear()
+        self.partitions.clear()
+
+    # -- the decision point --------------------------------------------------
+    def _count(self, key: str) -> None:
+        self.stats[key] = self.stats.get(key, 0) + 1
+        if self.perf is not None:
+            self.perf.inc(key)
+
+    def _partitioned(self, local: str, peer: str) -> bool:
+        for a, b in self.partitions:
+            if (_match_name(a, local) and _match_name(b, peer)) or \
+                    (_match_name(b, local) and _match_name(a, peer)):
+                return True
+        return False
+
+    def decide(self, direction: str, local: str, peer: str,
+               mtype: str) -> FaultDecision:
+        """One deterministic decision for one message traversal."""
+        if self._partitioned(local, peer):
+            self._count("partition_dropped")
+            return FaultDecision(drop=True)
+        out = FaultDecision()
+        for rule in self.rules:
+            if not rule.matches(direction, peer, mtype):
+                continue
+            # the RNG is consumed ONLY for matching rules with p < 1 so
+            # unrelated traffic cannot shift the schedule of the flow
+            # under test
+            if rule.probability < 1.0 and \
+                    self._rng.random() >= rule.probability:
+                continue
+            rule.fired += 1
+            if rule.count is not None:
+                rule.count -= 1
+            if rule.action == "drop":
+                self._count("dropped")
+                out.drop = True
+                return out
+            if rule.action == "delay":
+                self._count("delayed")
+                out.delay += rule.delay
+            elif rule.action == "dup":
+                self._count("duplicated")
+                out.copies += 1
+        return out
+
+    def on_send(self, local: str, peer: str,
+                mtype: str) -> FaultDecision:
+        return self.decide(SEND, local, peer, mtype)
+
+    def on_recv(self, local: str, peer: str,
+                mtype: str) -> FaultDecision:
+        return self.decide(RECV, local, peer, mtype)

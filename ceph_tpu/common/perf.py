@@ -29,6 +29,12 @@ class PerfCounters:
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + by
 
+    def get(self, key: str, default: int = 0) -> int:
+        """One plain counter's value without a full dump() (chaos/test
+        assertions read single counters in tight loops)."""
+        with self._lock:
+            return self._counters.get(key, default)
+
     def set_gauge(self, key: str, value: float) -> None:
         with self._lock:
             self._gauges[key] = value

@@ -260,12 +260,20 @@ class OSDMap:
         return None if pid is None else self.pools.get(pid)
 
     def osd_weights(self) -> list[int]:
-        """CRUSH input weight vector: 0 for down+out, reweight otherwise."""
+        """CRUSH input weight vector: 0 for out, reweight otherwise.
+
+        Down-but-IN OSDs KEEP their weight (the reference feeds only
+        in/out + reweight into CRUSH; up/down is applied by the post-
+        filter in pg_to_up_acting).  Zeroing a down OSD here would
+        re-run CRUSH without it and RESHUFFLE the raw placement -- for
+        EC pools the acting-set position IS the shard id, so a reshuffle
+        relabels every surviving OSD's stored shard bytes (the
+        degraded-read corruption pinned by tests/test_ec_degraded.py)."""
         n = max([self.max_osd] + [o + 1 for o in self.osds]) if self.osds \
             else self.max_osd
         w = [0] * n
         for osd, info in self.osds.items():
-            if info.in_cluster and info.up:
+            if info.in_cluster:
                 w[osd] = info.weight
         return w
 
@@ -307,20 +315,23 @@ class OSDMap:
                             weights)
         raw = self._apply_upmap(pgid, raw)
         # filter nonexistent/down osds (_raw_to_up_osds, OSDMap.cc:2773):
-        # replicated pools shift the survivors up; EC pools keep NONE
-        # holes because the acting-set position IS the shard id
+        # replicated pools shift the survivors up; EC pools keep holes
+        # because the acting-set position IS the shard id.  Holes are
+        # NORMALIZED to -1 here -- every consumer downstream (pg.py
+        # role/shard logic, clients, tools) uses the `o >= 0` test, and
+        # a raw CRUSH_ITEM_NONE (2^31-1) leaking through reads as a
+        # live osd id (the no-primary wedge the degraded-read repro hit)
+        def live(o: int) -> bool:
+            return o != CRUSH_ITEM_NONE and o >= 0 and self.is_up(o)
         if pool.can_shift_osds():
-            up = [o for o in raw
-                  if o != CRUSH_ITEM_NONE and self.is_up(o)]
+            up = [o for o in raw if live(o)]
         else:
-            up = [o if (o != CRUSH_ITEM_NONE and self.is_up(o))
-                  else CRUSH_ITEM_NONE for o in raw]
+            up = [o if live(o) else -1 for o in raw]
         temp = self.pg_temp.get(pgid)
         if temp:
-            acting = [o if (o != CRUSH_ITEM_NONE and self.is_up(o))
-                      else CRUSH_ITEM_NONE for o in temp]
+            acting = [o if live(o) else -1 for o in temp]
             if pool.can_shift_osds():
-                acting = [o for o in acting if o != CRUSH_ITEM_NONE]
+                acting = [o for o in acting if o >= 0]
             if not acting:
                 acting = up
         else:
@@ -332,8 +343,9 @@ class OSDMap:
         return self.pg_to_up_acting(pool_id, ps)[1]
 
     def pg_primary(self, up: list[int]) -> int | None:
+        # holes are -1 post-normalization; tolerate raw NONE too
         for o in up:
-            if o != CRUSH_ITEM_NONE:
+            if o >= 0 and o != CRUSH_ITEM_NONE:
                 return o
         return None
 

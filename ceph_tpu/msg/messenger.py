@@ -91,6 +91,23 @@ class Connection:
     async def send(self, msg: Message) -> None:
         if msg.type == ACK_TYPE:
             raise ValueError(f"{ACK_TYPE} is a reserved control frame type")
+        faults = self.messenger.faults
+        if faults is not None:
+            fd = faults.on_send(self.messenger.name, self.peer_name,
+                                msg.type)
+            if fd.drop:
+                return           # vanished on the wire (chaos drop)
+            if fd.delay > 0:
+                await asyncio.sleep(fd.delay)
+            for _ in range(fd.copies - 1):
+                # duplicates take fresh seqs so the receiver's replay
+                # dedup does NOT absorb them -- handler idempotency is
+                # exactly what the duplication fault probes
+                await self._send_one(Message(msg.type, dict(msg.data),
+                                             segments=list(msg.segments)))
+        await self._send_one(msg)
+
+    async def _send_one(self, msg: Message) -> None:
         while True:
             # window wait OUTSIDE the lock: _reconnect needs _send_lock
             # for the writer swap+replay, and the acks that reopen the
@@ -217,9 +234,14 @@ class Messenger:
                  ack_every: int = ACK_EVERY,
                  ack_bytes: int = ACK_BYTES,
                  compression: str | None = None,
-                 secure: bool = False) -> None:
+                 secure: bool = False,
+                 faults=None) -> None:
         self.name = name
         self.secret = secret
+        # deterministic message mangling (common/faults.py): consulted
+        # on every app-level send and every delivered message; None in
+        # production paths
+        self.faults = faults
         # on-wire transforms this endpoint OFFERS/accepts; the server
         # picks during the handshake (ProtocolV2 negotiation)
         self.compression = compression
@@ -629,14 +651,28 @@ class Messenger:
                 if not conn.outgoing:
                     self._sessions[conn.peer_name] = msg.seq
                 conn._note_delivered(len(buf))
+                copies, delay = 1, 0.0
+                if self.faults is not None:
+                    # recv-side injection happens ABOVE the transport:
+                    # seq/ack accounting already ran, so a dropped
+                    # message is "lost in the daemon", not a wire error
+                    # the lossless replay would transparently heal
+                    fd = self.faults.on_recv(
+                        self.name, conn.peer_name or msg.from_name,
+                        msg.type)
+                    if fd.drop:
+                        continue
+                    copies, delay = fd.copies, fd.delay
                 # dispatch in a task: a handler that itself RPCs back to
                 # this peer must not block the read loop its reply rides
                 # on (the reference's DispatchQueue decoupling).  Task
                 # creation order preserves ordering for handlers'
                 # synchronous prefixes.
-                t = asyncio.ensure_future(self._dispatch_one(conn, msg))
-                self._accept_tasks.add(t)
-                t.add_done_callback(self._accept_tasks.discard)
+                for _ in range(copies):
+                    t = asyncio.ensure_future(
+                        self._dispatch_one(conn, msg, delay))
+                    self._accept_tasks.add(t)
+                    t.add_done_callback(self._accept_tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionError, ValueError):
             if conn.outgoing and not conn.closed:
                 # lossless policy: try to re-establish and replay
@@ -667,7 +703,10 @@ class Messenger:
         except (ConnectionError, OSError):
             pass
 
-    async def _dispatch_one(self, conn: Connection, msg: Message) -> None:
+    async def _dispatch_one(self, conn: Connection, msg: Message,
+                            delay: float = 0.0) -> None:
+        if delay > 0:
+            await asyncio.sleep(delay)
         for d in list(self.dispatchers):
             try:
                 await d(conn, msg)

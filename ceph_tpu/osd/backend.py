@@ -26,7 +26,21 @@ SIZE_XATTR = "_size"
 VER_XATTR = "_ver"     # per-object version stamp, "epoch,v" (object_info_t
                        # analog: lets readers reject stale shards and lets
                        # backfill diff object versions without log overlap)
-HIDDEN_XATTRS = frozenset({SIZE_XATTR, VER_XATTR})   # never client-visible
+SHARD_XATTR = "_shard"  # WRITE-TIME-PINNED shard id of the stored bytes
+                        # (shard_id_t in the reference's ghobject): reads
+                        # and recovery verify this label instead of
+                        # trusting the OSD's CURRENT acting-set position,
+                        # which changes across re-peering
+CRC_XATTR = "_crc"      # crc32 of the stored shard bytes (the per-shard
+                        # hashinfo digest): rejects payloads/replies whose
+                        # bytes don't match their claimed identity
+HIDDEN_XATTRS = frozenset({SIZE_XATTR, VER_XATTR, SHARD_XATTR,
+                           CRC_XATTR})               # never client-visible
+
+
+def shard_crc(data) -> int:
+    import zlib
+    return zlib.crc32(bytes(data)) & 0xFFFFFFFF
 
 
 def ver_encode(version) -> bytes:
@@ -325,6 +339,22 @@ class ECBackend(PGBackend):
             self.codec, stripe_unit=parse_stripe_unit(
                 self.codec, profile.get("stripe_unit", 4096)))
         self.cache = ExtentCache()
+        # degraded-path observability (perf counter set "ec_degraded"):
+        # reconstructions actually run, mislabeled/corrupt shards
+        # rejected, gather retry rounds (None on bare-backend tests)
+        perf = getattr(self.osd, "perf", None)
+        self.perf_degraded = perf.create("ec_degraded") \
+            if perf is not None else None
+
+    def _count(self, key: str, by: int = 1) -> None:
+        if self.perf_degraded is not None:
+            self.perf_degraded.inc(key, by)
+
+    def _cfg(self, name: str, default):
+        cfg = getattr(self.osd, "config", None)
+        if not isinstance(cfg, dict):
+            return default
+        return type(default)(cfg.get(name, default))
 
     @property
     def batcher(self):
@@ -344,7 +374,28 @@ class ECBackend(PGBackend):
         return self.sinfo.k
 
     def my_shard(self) -> int:
+        """This OSD's shard position in the CURRENT acting set.  The
+        PG-pinned shard_id (write-time identity) normally agrees; when
+        they diverge the PG has been remapped and pg._check_shard_identity
+        already queued the local objects for re-recovery."""
         return self.pg.acting.index(self.osd.whoami)
+
+    def shard_label(self, oid: str) -> int | None:
+        """The WRITE-TIME shard id of the locally stored bytes: the
+        per-object pin first, the PG-level pin as fallback for objects
+        predating per-object stamps, else the current acting position."""
+        raw = self.store.getattr(self.coll, oid, SHARD_XATTR)
+        if raw is not None:
+            try:
+                return int(raw)
+            except ValueError:
+                pass
+        if self.pg.shard_id is not None:
+            return self.pg.shard_id
+        try:
+            return self.my_shard()
+        except ValueError:
+            return None
 
     def invalidate_extents(self, oid: str | None = None) -> None:
         if oid is None:
@@ -367,17 +418,67 @@ class ECBackend(PGBackend):
         ver = ver_decode(self.store.getattr(self.coll, oid, VER_XATTR))
         return np.frombuffer(raw, np.uint8), int(sx) if sx else 0, ver
 
+    def _label_ok(self, shard: int, label, buf, ver) -> bool:
+        """Is a stored/reported shard label consistent with serving
+        position ``shard``?  Absent objects (no version, no bytes) are
+        consistent everywhere; an explicit mismatched label means the
+        bytes were written AS a different shard -- decoding them under
+        this position is the mislabeling corruption, so the source is
+        rejected instead."""
+        if tuple(ver) == (0, 0) and not len(buf):
+            return True
+        return label is None or int(label) == shard
+
     async def _fetch_shards(self, oid: str, shards: list[int],
                             avail: dict[int, int],
-                            rng: tuple[int, int] | None = None) -> dict:
+                            rng: tuple[int, int] | None = None,
+                            timeout: float = 10.0
+                            ) -> tuple[dict, set[int], dict]:
         """Fetch several shards' (buf, size, ver) with ONE parallel
         fanout (the hot read path: serial round trips would multiply
-        latency by k)."""
-        out = {}
+        latency by k).
+
+        Returns (fetched, failed, relabeled): a shard lands in
+        ``failed`` when its source did not answer inside ``timeout``,
+        reported a mismatched write-time shard label, or returned bytes
+        that fail the CRC tag -- the caller excludes those sources and
+        re-plans, so a dead or mislabeled source can never wedge or
+        corrupt a read.  A mismatched source whose bytes verify under
+        their OWN label goes into ``relabeled`` keyed by that label: a
+        remapped OSD's old-shard bytes are still perfectly good data
+        for the shard they WERE, and using them is what lets reads and
+        recovery converge while relocation is in flight."""
+        out: dict[int, tuple] = {}
+        failed: set[int] = set()
+        relabeled: dict[int, tuple] = {}
+
+        def classify(s: int, label, crc, buf, size, ver) -> None:
+            if not self._label_ok(s, label, buf, ver):
+                self._count("shard_mismatch")
+                failed.add(s)
+                # CRC-verified bytes under their OWN label are salvage,
+                # not garbage (ranged reads can't re-check the whole-
+                # shard crc; the label xattr alone vouches there)
+                if label is not None and int(label) >= 0 and \
+                        (rng is not None or crc is None
+                         or shard_crc(buf) == int(crc)):
+                    relabeled.setdefault(int(label), (buf, size, ver))
+                return
+            if rng is None and crc is not None \
+                    and shard_crc(buf) != int(crc):
+                self._count("crc_mismatch")
+                failed.add(s)
+                return
+            out[s] = (buf, size, ver)
+
         remote = []
         for s in shards:
             if avail[s] == self.osd.whoami:
-                out[s] = self._local_shard(oid, rng)
+                buf, size, ver = self._local_shard(oid, rng)
+                crc_raw = self.store.getattr(self.coll, oid, CRC_XATTR)
+                classify(s, self.shard_label(oid),
+                         int(crc_raw) if crc_raw is not None else None,
+                         buf, size, ver)
             else:
                 remote.append(s)
         if remote:
@@ -385,22 +486,23 @@ class ECBackend(PGBackend):
             if rng is not None:
                 payload["off"], payload["len"] = rng
             replies = await self.osd.fanout_and_wait(
-                [(avail[s], "ec_subop_read", dict(payload), [])
+                [(avail[s], "ec_subop_read",
+                  {**payload, "shard": s}, [])
                  for s in remote],
-                collect=True)
+                collect=True, timeout=timeout)
             for rep in replies:
-                s = rep.data.get("shard")
-                if s is None:
+                s = rep.data.get("req_shard", rep.data.get("shard"))
+                if s is None or s not in remote:
                     continue
                 buf = np.frombuffer(
                     rep.segments[0] if rep.segments else b"", np.uint8)
-                out[s] = (buf, rep.data.get("size", 0),
-                          tuple(rep.data.get("ver", (0, 0))))
-            missing = [s for s in remote if s not in out]
-            if missing:
-                raise TimeoutError(
-                    f"ec_subop_read: no reply for shards {missing}")
-        return out
+                classify(s, rep.data.get("shard"),
+                         rep.data.get("crc"), buf,
+                         rep.data.get("size", 0),
+                         tuple(rep.data.get("ver", (0, 0))))
+            failed |= {s for s in remote
+                       if s not in out and s not in failed}
+        return out, failed, relabeled
 
     async def _gather_shards(self, oid: str,
                              need_shards: set[int] | None = None,
@@ -421,14 +523,45 @@ class ECBackend(PGBackend):
         for shard, osd in enumerate(acting):
             if osd >= 0 and self.osd.osd_is_up(osd):
                 avail[shard] = osd
-        want = need_shards or set(self.sinfo.data_positions(self.codec))
+        want = set(need_shards
+                   or self.sinfo.data_positions(self.codec))
+        if not want <= set(avail):
+            self._count("degraded_reads")    # a decode must reconstruct
+        retries = self._cfg("osd_ec_read_retries", 3)
+        timeout = self._cfg("osd_ec_read_timeout", 5.0)
+        backoff = self._cfg("osd_ec_read_backoff", 0.25)
         fetched: dict[int, tuple[np.ndarray, int, tuple]] = {}
         rejected: set[int] = set()
-        for _ in range(len(acting) + 1):
-            usable = set(avail) - rejected
-            plan = set(self.codec.minimum_to_decode(want, usable))
-            fetched.update(await self._fetch_shards(
-                oid, sorted(plan - set(fetched)), avail, rng))
+        # bounded: staleness can reject at most len(acting) shards and
+        # transient fetch failures get `retries` extra rounds -- beyond
+        # that the read ERRORS instead of wedging (the seed's unbounded
+        # wait turned one dead source into a hung client read)
+        for attempt in range(retries + len(acting) + 1):
+            # what's already verified in hand (including relabeled
+            # salvage from remapped holders) counts as available
+            usable = (set(avail) | set(fetched)) - rejected
+            try:
+                plan = set(self.codec.minimum_to_decode(want, usable))
+            except Exception as e:
+                raise IOError(
+                    f"EIO {oid}: cannot decode shards {sorted(want)} "
+                    f"from {sorted(usable)}") from e
+            to_fetch = sorted(s for s in plan - set(fetched)
+                              if s in avail)
+            got, failed, relabeled = await self._fetch_shards(
+                oid, to_fetch, avail, rng, timeout)
+            fetched.update(got)
+            for label, item in relabeled.items():
+                # direct position-keyed fetches take precedence over
+                # salvage; salvage never overwrites either
+                fetched.setdefault(label, item)
+            if failed:
+                rejected |= failed
+                self._count("gather_retries")
+                if backoff > 0 and attempt < retries:
+                    await asyncio.sleep(min(backoff * (2 ** attempt),
+                                            2.0))
+                continue                     # re-plan around the losses
             vers = {s: fetched[s][2] for s in plan}
             newest = max(vers.values())
             stale = {s for s, v in vers.items() if v < newest}
@@ -448,13 +581,19 @@ class ECBackend(PGBackend):
                         bufs[s] = nb
                 return bufs, size, newest
             rejected |= stale
-        raise RuntimeError(
-            f"no consistent shard set for {oid}: rejected {sorted(rejected)}")
+            for s in stale:
+                fetched.pop(s, None)
+        self._count("gather_failures")
+        raise IOError(
+            f"EIO {oid}: no consistent shard set "
+            f"(rejected {sorted(rejected)})")
 
     async def _read_logical(self, oid: str) -> bytes:
         bufs, size, _ = await self._gather_shards(oid)
         if not bufs or not any(len(b) for b in bufs.values()):
             return b""
+        if not set(self.sinfo.data_positions(self.codec)) <= set(bufs):
+            self._count("reconstructions")   # decode fills a data shard
         data = await self.sinfo.reconstruct_logical_async(
             self.codec, bufs, batcher=self.batcher)
         return data[:size]
@@ -479,7 +618,7 @@ class ECBackend(PGBackend):
                     continue
                 if osd == self.osd.whoami:
                     self.apply_sub_write(entry, {"touch": True}, [],
-                                         attr_muts)
+                                         attr_muts, shard=shard)
                 elif not self.pg.should_send_to(osd, entry.oid):
                     awaiting.append(
                         self._log_only_subop(osd, shard, entry))
@@ -559,7 +698,8 @@ class ECBackend(PGBackend):
                 continue
             if osd == self.osd.whoami:
                 self.apply_sub_write(entry, per_shard[shard],
-                                     segs_per_shard[shard], attr_muts)
+                                     segs_per_shard[shard], attr_muts,
+                                     shard=shard)
             elif not self.pg.should_send_to(osd, entry.oid):
                 awaiting.append(self._log_only_subop(osd, shard, entry))
             else:
@@ -726,7 +866,8 @@ class ECBackend(PGBackend):
                             for off, buf in shard_writes[shard]]}
             segs = [buf for _, buf in shard_writes[shard]]
             if osd == self.osd.whoami:
-                self.apply_sub_write(entry, w, segs, attr_muts)
+                self.apply_sub_write(entry, w, segs, attr_muts,
+                                     shard=shard)
             elif not self.pg.should_send_to(osd, oid):
                 awaiting.append(self._log_only_subop(osd, shard, entry))
             else:
@@ -739,7 +880,8 @@ class ECBackend(PGBackend):
             await self._fanout_commits(awaiting, entry)
 
     def apply_sub_write(self, entry: LogEntry, w: dict,
-                        segs: list[bytes], attr_muts: list[dict]) -> None:
+                        segs: list[bytes], attr_muts: list[dict],
+                        shard: int | None = None) -> None:
         txn = Transaction()
         oid = entry.oid
         if w.get("log_only"):
@@ -747,6 +889,17 @@ class ECBackend(PGBackend):
             self.pg.append_log_and_meta(txn, entry)
             self.store.queue_transaction(txn)
             return
+        # write-time identity pin: remember which shard these bytes ARE
+        # (per-object xattr) and which shard this PG instance serves
+        # (PG meta, persisted by append_log_and_meta below) -- readers
+        # and recovery verify against the pin, never the live index
+        if shard is None:
+            try:
+                shard = self.my_shard()
+            except ValueError:
+                shard = self.pg.shard_id
+        if shard is not None and self.pg.shard_id is None:
+            self.pg.shard_id = shard
         if w.get("remove"):
             txn.remove(self.coll, oid)
         elif w.get("writes") is not None:
@@ -780,6 +933,25 @@ class ECBackend(PGBackend):
         apply_mutations(txn, self.coll, oid, attr_muts)
         self.pg.append_log_and_meta(txn, entry)
         self.store.queue_transaction(txn)
+        if not w.get("remove"):
+            self._stamp_identity(oid, shard)
+
+    def _stamp_identity(self, oid: str, shard: int | None) -> None:
+        """Post-commit identity tag: shard label + CRC of the FINAL
+        shard content (ranged RMW writes touch slices, so the digest is
+        taken from the store after the txn applied -- queue_transaction
+        is synchronous, no interleaving await)."""
+        try:
+            cur = self.store.read(self.coll, oid, 0, None)
+        except FileNotFoundError:
+            return
+        txn = Transaction()
+        if shard is not None:
+            txn.setattr(self.coll, oid, SHARD_XATTR,
+                        str(int(shard)).encode())
+        txn.setattr(self.coll, oid, CRC_XATTR,
+                    str(shard_crc(cur)).encode())
+        self.store.queue_transaction(txn)
 
     # -- read path ----------------------------------------------------------
     async def object_read(self, oid, off, length) -> bytes:
@@ -808,14 +980,21 @@ class ECBackend(PGBackend):
             # reconstruction decode rides the batcher: concurrent
             # recovery/backfill pushes for the same down-shard pattern
             # share one decode_batch launch
+            self._count("reconstructions")
             decoded = await self.sinfo.decode_async(
                 self.codec, bufs, want={shard}, batcher=self.batcher)
             buf = decoded[shard]
-        # the pushed shard must carry the version stamp: an unstamped
+        # the pushed shard must carry the version stamp (an unstamped
         # recovered shard would read as (0,0) and be rejected as stale
-        # by _gather_shards forever after
-        ver_raw = f"{ver[0]},{ver[1]}".encode()
-        return {"data": buf.tobytes(),
+        # by _gather_shards forever after) AND its identity pin: the
+        # shard label + CRC travel in the xattrs so the applied copy is
+        # self-describing, and again at the payload top level so the
+        # receiver can verify BEFORE applying anything
+        raw = buf.tobytes()
+        return {"data": raw,
                 "xattrs": {SIZE_XATTR: str(size).encode(),
-                           VER_XATTR: ver_raw},
-                "omap": {}}
+                           VER_XATTR: f"{ver[0]},{ver[1]}".encode(),
+                           SHARD_XATTR: str(int(shard)).encode(),
+                           CRC_XATTR: str(shard_crc(raw)).encode()},
+                "omap": {},
+                "shard": int(shard)}

@@ -31,8 +31,13 @@ class OSD:
                  admin_socket_path: str | None = None,
                  msgr_opts: dict | None = None,
                  cephx_key: str | None = None,
-                 require_ticket: bool = False) -> None:
+                 require_ticket: bool = False,
+                 fault_injector=None) -> None:
         self.msgr_opts = msgr_opts
+        # deterministic chaos (common/faults.py MessageFaultInjector):
+        # threaded into the messenger at start(); its firings surface
+        # in the "fault_inject" perf counter set.  None in production.
+        self.faults = fault_injector
         # cephx: this OSD's entity key (hex).  When set, boot fetches
         # the rotating "osd" service keys (to VALIDATE tickets peers
         # present) and its own ticket (to PRESENT on osd->osd
@@ -172,7 +177,10 @@ class OSD:
         self.store.mount()
         name = f"osd.{self.whoami}" if self.whoami >= 0 else \
             f"osd-boot-{self.uuid[:8]}"
+        if self.faults is not None and self.faults.perf is None:
+            self.faults.perf = self.perf.create("fault_inject")
         self.msgr = Messenger(name, secret=self.secret,
+                              faults=self.faults,
                               **(self.msgr_opts or {}))
         self.msgr.add_dispatcher(self._dispatch)
         addr = await self.msgr.bind(host, port)
@@ -986,7 +994,8 @@ class OSD:
             attr_muts = unpack_mutations(msg.data.get("attr_muts", []),
                                          msg.segments[n_data_segs:])
             pg.backend.apply_sub_write(
-                entry, w, msg.segments[:n_data_segs], attr_muts)
+                entry, w, msg.segments[:n_data_segs], attr_muts,
+                shard=msg.data.get("shard"))
             self.perf_osd.inc("subop_w")
         await conn.send(Message("ec_subop_write_reply",
                                 {"tid": msg.data.get("tid"),
@@ -1099,7 +1108,11 @@ class OSD:
 
     async def _h_ec_subop_read(self, conn, msg) -> None:
         pg = self._get_pg(msg.data["pgid"])
-        data, buf, size = {"tid": msg.data.get("tid")}, b"", 0
+        data, buf = {"tid": msg.data.get("tid")}, b""
+        if msg.data.get("shard") is not None:
+            # echo what the requester ASKED for, so it can match the
+            # reply to its plan independently of what we report below
+            data["req_shard"] = int(msg.data["shard"])
         if pg is not None:
             oid = msg.data["oid"]
             off = int(msg.data.get("off", 0))
@@ -1108,13 +1121,24 @@ class OSD:
                 buf = self.store.read(pg.coll, oid, off, length)
             except FileNotFoundError:
                 buf = b""
-            from .backend import SIZE_XATTR, VER_XATTR, ver_decode
+            from .backend import (CRC_XATTR, SIZE_XATTR, VER_XATTR,
+                                  ver_decode)
             sx = self.store.getattr(pg.coll, oid, SIZE_XATTR)
-            size = int(sx) if sx else 0
-            data["shard"] = pg._shard_of(self.whoami)
-            data["size"] = size
+            data["size"] = int(sx) if sx else 0
             data["ver"] = list(ver_decode(
                 self.store.getattr(pg.coll, oid, VER_XATTR)))
+            # report the WRITE-TIME identity of the stored bytes (per-
+            # object pin, PG pin fallback), NOT the current acting-set
+            # index: after a re-peer the index is a claim about where
+            # shards SHOULD live; the label is what these bytes ARE.
+            # The reader rejects a mismatch instead of decoding garbage.
+            label = pg.backend.shard_label(oid) \
+                if hasattr(pg.backend, "shard_label") else None
+            if label is not None:
+                data["shard"] = int(label)
+            crc = self.store.getattr(pg.coll, oid, CRC_XATTR)
+            if crc is not None:
+                data["crc"] = int(crc)
         await conn.send(Message("ec_subop_read_reply", data,
                                 segments=[buf]))
 

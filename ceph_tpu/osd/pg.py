@@ -62,6 +62,16 @@ class PG:
         self.past_intervals = PastIntervals()
         self.up: list[int] = []
         self.acting: list[int] = []
+        # WRITE-TIME-PINNED shard identity of this PG instance (EC
+        # pools; the spg_t shard of the reference).  Pinned when the
+        # first shard write lands, persisted with the PG meta, and kept
+        # across acting-set changes -- the CURRENT acting index is a
+        # claim about placement, the pin is a fact about the bytes on
+        # disk.  When the map genuinely remaps this OSD to a different
+        # position, _check_shard_identity queues every local object for
+        # re-recovery instead of serving old-shard bytes under the new
+        # label.
+        self.shard_id: int | None = None
         self.state = "initial"
         # transition trace for introspection/tests (NamedState events)
         self.state_history: list[str] = ["initial"]
@@ -128,10 +138,12 @@ class PG:
             self.past_intervals = got
         if "trimmed_snaps" in omap:
             self.trimmed_snaps = set(json.loads(omap["trimmed_snaps"]))
+        if omap.get("shard"):
+            self.shard_id = int(omap["shard"])
 
     def _meta_kv(self) -> dict[str, bytes]:
         from ..common.denc import denc_bytes
-        return {
+        kv = {
             "info": denc_bytes(self.info),
             "log": denc_bytes(self.log),
             "missing": denc_bytes(self.missing),
@@ -139,6 +151,9 @@ class PG:
             "trimmed_snaps": json.dumps(
                 sorted(self.trimmed_snaps)).encode(),
         }
+        if self.shard_id is not None:
+            kv["shard"] = str(self.shard_id).encode()
+        return kv
 
     def persist_meta(self, txn: Transaction | None = None) -> None:
         own = txn is None
@@ -223,6 +238,8 @@ class PG:
         self.up = list(up)
         self.acting = list(acting)
         self.info.same_interval_since = epoch
+        if not self.pool.can_shift_osds():
+            self._check_shard_identity()
         self._set_state("peering" if self.is_primary() else "stray")
         self.backend.invalidate_extents()   # interval change: stale cache
         if self._recovery_task:
@@ -236,6 +253,37 @@ class PG:
             self._snap_trim_task = None
         self.watchers.clear()     # clients re-watch on the new interval
         return True
+
+    def _check_shard_identity(self) -> None:
+        """EC pools: reconcile the write-time shard pin with the new
+        acting position.
+
+        Same position (the common case -- holes keep positions stable
+        across down events): nothing to do.  A GENUINE remap (this OSD
+        now serves a different shard, e.g. after a mark-out rebalance):
+        the local bytes are the OLD shard and must not be served under
+        the new label, so every local object is queued for re-recovery
+        at its stored version and the pin moves.  The per-object shard
+        xattrs keep rejecting the stale bytes until recovery rewrites
+        them (backend read verification), so a slow recovery degrades
+        reads instead of corrupting them."""
+        try:
+            pos = self.acting.index(self.whoami)
+        except ValueError:
+            return                   # not serving this interval
+        if self.shard_id is None:
+            return                   # pinned by the first shard write
+        if pos == self.shard_id:
+            return
+        from ..common.log import log_context
+        log_context().log(
+            "osd", 1,
+            f"pg {self.pgid}: osd.{self.whoami} remapped shard "
+            f"{self.shard_id} -> {pos}; re-recovering local objects")
+        for oid, ver in self.object_vers().items():
+            self.missing.add(oid, need=EVersion(*ver), have=ZERO)
+        self.shard_id = pos
+        self.persist_meta()
 
     # -- peering (primary drives GetInfo -> GetLog -> Activate) -------------
     def kick_peering(self) -> None:
@@ -252,7 +300,13 @@ class PG:
         peering on every unqueried up peer; an unreachable-but-up peer
         stalls peering until the mons mark it down, which starts a new
         interval and a fresh peering attempt)."""
+        import random as _random
         epoch = self.osd.osdmap.epoch
+        cfg = self.osd.config
+        base = float(cfg.get("osd_peering_retry_base", 0.5))
+        cap = float(cfg.get("osd_peering_retry_max", 8.0))
+        jitter = float(cfg.get("osd_peering_retry_jitter", 0.25))
+        attempt = 0
         while True:
             if (not self.is_primary()
                     or self.osd.osdmap.epoch != epoch):
@@ -265,15 +319,24 @@ class PG:
                 raise
             except (ConnectionError, OSError, asyncio.TimeoutError,
                     KeyError, ValueError):
-                await asyncio.sleep(0.5)
+                # exponential backoff with jitter: N primaries retrying
+                # a shared dead peer must not hammer it in lockstep
+                delay = min(base * (2 ** attempt), cap)
+                delay *= 1.0 + jitter * _random.random()
+                attempt += 1
+                await asyncio.sleep(delay)
 
-    async def _await_acting_change(self, timeout: float = 10.0) -> None:
+    async def _await_acting_change(self,
+                                   timeout: float | None = None) -> None:
         """WaitActingChange: a pg_temp override was requested; hold
         peering until the map reflecting it arrives (PeeringState.h:802
         -- queries are answered, I/O is not served).  The new map's
         update_mapping CANCELS this task, so running the full sleep
         always means the override never landed (mon unreachable) and
         the caller falls back to serving the interval itself."""
+        if timeout is None:
+            timeout = float(self.osd.config.get(
+                "osd_wait_acting_change_timeout", 10.0))
         await asyncio.sleep(timeout)
 
     async def _peer_locked(self) -> None:
@@ -634,15 +697,26 @@ class PG:
             for op in ops:
                 name = op["op"]
                 if name in READ_OPS:
-                    if writes:
-                        if overlay is None:
-                            overlay = await self._make_overlay(oid)
-                        if applied < len(writes):
-                            self._apply_overlay(overlay, writes[applied:])
-                            applied = len(writes)
-                        r, seg = self._read_overlay_op(overlay, oid, op)
-                    else:
-                        r, seg = await self._do_read_op(read_oid, op)
+                    # a degraded read that exhausted its bounded shard
+                    # retries must ERROR (client sees EIO inside its
+                    # deadline), never propagate and leave the op
+                    # without a reply -- that is the wedged-read mode
+                    try:
+                        if writes:
+                            if overlay is None:
+                                overlay = await self._make_overlay(oid)
+                            if applied < len(writes):
+                                self._apply_overlay(overlay,
+                                                    writes[applied:])
+                                applied = len(writes)
+                            r, seg = self._read_overlay_op(overlay, oid,
+                                                           op)
+                        else:
+                            r, seg = await self._do_read_op(read_oid, op)
+                    except (OSError, ConnectionError, TimeoutError,
+                            asyncio.TimeoutError, RuntimeError,
+                            ValueError) as e:
+                        r, seg = {"err": "EIO", "detail": str(e)}, None
                     if seg is not None:
                         r["seg"] = len(segments)
                         segments.append(seg)
@@ -694,8 +768,18 @@ class PG:
             if writes:
                 if top is not None:
                     top.event("started")
-                err = await self._do_writes(oid, writes, reqid,
-                                            snapc=snapc)
+                try:
+                    err = await self._do_writes(oid, writes, reqid,
+                                                snapc=snapc)
+                except (OSError, ConnectionError, TimeoutError,
+                        asyncio.TimeoutError, RuntimeError,
+                        ValueError) as e:
+                    # commit fan-out failed mid-flight: answer EAGAIN so
+                    # the client RETRIES (reqid dedup absorbs a partial
+                    # local apply) instead of timing out reply-less
+                    err = "EAGAIN"
+                    if top is not None:
+                        top.event(f"write_failed: {e}")
                 if top is not None:
                     top.event("commit_sent")
                 if err:
@@ -1202,7 +1286,8 @@ class PG:
                     self._maybe_clear_pg_temp()
                     async with self.lock:
                         self.persist_meta()
-                except (ConnectionError, OSError, asyncio.TimeoutError):
+                except (ConnectionError, OSError, asyncio.TimeoutError,
+                        ValueError):
                     pass
                 if self._recovery_pending():
                     await asyncio.sleep(0.5)
@@ -1250,19 +1335,33 @@ class PG:
     @staticmethod
     def _push_payload(oid: str, payload: dict) -> tuple[dict, list]:
         """Wire form of a recovery/backfill payload (shared by push,
-        backfill push and the pull reply -- one place owns the format)."""
-        return ({"oid": oid,
-                 "absent": payload.get("absent", False),
-                 "xattrs": {k: v.hex()
-                            for k, v in payload["xattrs"].items()},
-                 "omap": {k: v.hex()
-                          for k, v in payload["omap"].items()}},
-                [payload["data"]])
+        backfill push and the pull reply -- one place owns the format).
+
+        Every payload carries its integrity tag: the CRC of the data
+        bytes and, for EC shards, the write-time shard id the bytes
+        were encoded as.  The receiver verifies BOTH before applying
+        (_apply_recovery_payload) -- a mislabeled or corrupt payload is
+        rejected and retried, never silently installed."""
+        from .backend import shard_crc
+        data = {"oid": oid,
+                "absent": payload.get("absent", False),
+                "crc": shard_crc(payload["data"]),
+                "xattrs": {k: v.hex()
+                           for k, v in payload["xattrs"].items()},
+                "omap": {k: v.hex()
+                         for k, v in payload["omap"].items()}}
+        if payload.get("shard") is not None:
+            data["shard"] = int(payload["shard"])
+        return data, [payload["data"]]
 
     async def _backfill_push(self, peer: int, oid: str) -> bool:
         """Push one object (or its absence) to a backfill target with
         the per-object interlock.  Returns True on ack."""
         bi = self.backfill_info[peer]
+        try:
+            shard = self._shard_of(peer)
+        except ValueError:
+            return False           # peer left the acting set; re-peered
         ev = asyncio.Event()
         try:
             # the lock is held ONLY to mark the interlock: no write is
@@ -1273,7 +1372,7 @@ class PG:
             async with self.lock:
                 bi["inflight"][oid] = ev
             payload = await self.backend.read_recovery_payload(
-                oid, self._shard_of(peer))
+                oid, shard)
             data, segs = self._push_payload(oid, payload)
             data["pgid"] = self.pgid
             replies = await self.osd.fanout_and_wait(
@@ -1415,7 +1514,24 @@ class PG:
                         pass
 
     def _shard_of(self, osd_id: int) -> int:
-        return self.acting.index(osd_id) if osd_id in self.acting else 0
+        """Shard position ``osd_id`` SERVES in the current acting set.
+
+        An OSD outside the acting set has no shard position; the seed's
+        silent `return 0` here was the corruption amplifier -- recovery
+        payloads and sub-op reads got labeled shard 0 and decoded as
+        data they were not.  Raising turns that into a retryable error
+        the caller's backoff absorbs (-1 holes are never valid inputs
+        and never match)."""
+        if osd_id >= 0 and osd_id in self.acting:
+            return self.acting.index(osd_id)
+        from ..common.log import log_context
+        log_context().log(
+            "osd", 1,
+            f"pg {self.pgid}: osd.{osd_id} not in acting {self.acting}"
+            f" -- no shard position")
+        raise ValueError(
+            f"pg {self.pgid}: osd.{osd_id} has no shard position in "
+            f"acting {self.acting}")
 
     async def _recover_object(self, oid: str) -> None:
         """Pull the authoritative copy (our shard of it) from a peer."""
@@ -1438,12 +1554,48 @@ class PG:
         if not replies or replies[0].data.get("err"):
             return                      # source not ready; retried later
         rep = replies[0]
-        self._apply_recovery_payload(oid, rep.data, rep.segments)
+        try:
+            self._apply_recovery_payload(oid, rep.data, rep.segments)
+        except ValueError:
+            return      # mislabeled/corrupt payload: keep missing, retry
         self.missing.items.pop(oid, None)
         self.persist_meta()
 
+    def _verify_recovery_payload(self, oid: str, data: dict,
+                                 segments: list[bytes]) -> None:
+        """Integrity gate on the recovery apply path: the payload's CRC
+        tag must match its bytes, and an EC shard payload must be
+        labeled with THE SHARD THIS OSD SERVES -- installing a
+        mislabeled shard is exactly the degraded-read corruption.
+        Raises ValueError; callers reply err / retry."""
+        from .backend import ReplicatedBackend, shard_crc
+        if data.get("absent"):
+            return
+        buf = segments[0] if segments else b""
+        if data.get("crc") is not None \
+                and shard_crc(buf) != int(data["crc"]):
+            self._count_degraded("crc_mismatch")
+            raise ValueError(
+                f"pg {self.pgid}/{oid}: recovery payload crc mismatch "
+                f"(got {shard_crc(buf)}, tagged {data['crc']})")
+        if data.get("shard") is None \
+                or isinstance(self.backend, ReplicatedBackend):
+            return
+        want = self._shard_of(self.whoami)
+        if int(data["shard"]) != want:
+            self._count_degraded("shard_mismatch")
+            raise ValueError(
+                f"pg {self.pgid}/{oid}: recovery payload is shard "
+                f"{data['shard']}, but this OSD serves shard {want}")
+
+    def _count_degraded(self, key: str) -> None:
+        pc = getattr(self.backend, "perf_degraded", None)
+        if pc is not None:
+            pc.inc(key)
+
     def _apply_recovery_payload(self, oid: str, data: dict,
                                 segments: list[bytes]) -> None:
+        self._verify_recovery_payload(oid, data, segments)
         self.backend.invalidate_extents(oid)
         txn = Transaction()
         if data.get("absent"):
@@ -1460,20 +1612,34 @@ class PG:
             if omap:
                 txn.omap_setkeys(self.coll, oid, omap)
         self.osd.store.queue_transaction(txn)
+        # an applied EC shard re-pins the PG identity (first write on a
+        # fresh replica may arrive via recovery rather than a sub-write)
+        if data.get("shard") is not None and self.shard_id is None:
+            self.shard_id = int(data["shard"])
 
     async def on_pull(self, msg) -> tuple[dict, list[bytes]]:
         """Serve a recovery read: reconstruct the REQUESTER's shard."""
         oid = msg.data["oid"]
         shard = msg.data.get("shard", 0)
-        payload = await self.backend.read_recovery_payload(oid, shard)
+        try:
+            payload = await self.backend.read_recovery_payload(oid,
+                                                               shard)
+        except (ConnectionError, OSError, asyncio.TimeoutError,
+                ValueError) as e:
+            # cannot assemble the shard right now: an ERROR reply lets
+            # the puller back off and retry instead of timing out
+            return ({"oid": oid, "err": "EIO", "detail": str(e)}, [])
         return self._push_payload(oid, payload)
 
     async def _push_object(self, peer: int, oid: str) -> None:
         ms = self.peer_missing.get(peer)
         if ms is None or not ms.is_missing(oid):
             return
-        payload = await self.backend.read_recovery_payload(
-            oid, self._shard_of(peer))
+        try:
+            shard = self._shard_of(peer)
+        except ValueError:
+            return        # peer left the acting set; next peering drops it
+        payload = await self.backend.read_recovery_payload(oid, shard)
         data, segs = self._push_payload(oid, payload)
         data["pgid"] = self.pgid
         replies = await self.osd.fanout_and_wait(
@@ -1485,7 +1651,16 @@ class PG:
     async def on_push(self, msg) -> dict:
         async with self.lock:
             oid = msg.data["oid"]
-            self._apply_recovery_payload(oid, msg.data, msg.segments)
+            try:
+                self._apply_recovery_payload(oid, msg.data,
+                                             msg.segments)
+            except ValueError as e:
+                # mislabeled/corrupt payload: REFUSE it (the primary
+                # keeps the object missing and retries) rather than
+                # installing bytes that would decode as garbage
+                return {"pgid": self.pgid, "oid": oid,
+                        "err": "EBADPAYLOAD", "detail": str(e),
+                        "from_osd": self.whoami}
             self.missing.items.pop(oid, None)
             if not self.missing:
                 self.info.last_complete = self.info.last_update

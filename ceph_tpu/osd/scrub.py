@@ -156,8 +156,9 @@ async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
     oids = [o for o in pg.osd.store.list_objects(pg.coll)
             if o != META_OID]
     res.objects_scrubbed = len(oids)
+    from .backend import CRC_XATTR, SHARD_XATTR, VER_XATTR, shard_crc
     for oid in oids:
-        bufs, size, _ = await backend._gather_shards(
+        bufs, size, ver = await backend._gather_shards(
             oid, need_shards=set(range(backend.k)))
         if not bufs:
             continue
@@ -165,8 +166,11 @@ async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
         pad = backend.sinfo.logical_to_next_stripe_offset(size)
         canonical = backend.sinfo.encode(
             backend.codec, logical[:pad].ljust(pad, b"\0"))
-        # fetch every stored shard and compare
+        # fetch every stored shard; compare bytes AND the write-time
+        # identity tags (shard label / crc) the degraded-read path
+        # trusts -- scrub is where silent tag rot gets caught
         bad_shards: list[int] = []
+        bad_tags: list[int] = []
         for shard, osd_id in enumerate(pg.acting):
             if osd_id < 0 or not pg.osd.osd_is_up(osd_id):
                 continue
@@ -175,36 +179,56 @@ async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
                     raw = pg.osd.store.read(pg.coll, oid, 0, None)
                 except FileNotFoundError:
                     raw = b""
+                label = backend.shard_label(oid)
+                crc = pg.osd.store.getattr(pg.coll, oid, CRC_XATTR)
+                crc = int(crc) if crc is not None else None
             else:
                 replies = await pg.osd.fanout_and_wait(
                     [(osd_id, "ec_subop_read",
-                      {"pgid": pg.pgid, "oid": oid}, [])],
+                      {"pgid": pg.pgid, "oid": oid, "shard": shard},
+                      [])],
                     collect=True, timeout=10)
                 if not replies:
                     continue
                 raw = (replies[0].segments[0]
                        if replies[0].segments else b"")
+                label = replies[0].data.get("shard")
+                crc = replies[0].data.get("crc")
             want = canonical[shard].tobytes()
             if bytes(raw) != want:
                 bad_shards.append(shard)
-        if bad_shards:
-            res.inconsistent[oid] = {"bad_shards": bad_shards}
+            elif (label is not None and int(label) != shard) or \
+                    (crc is not None and crc != shard_crc(raw)):
+                bad_tags.append(shard)
+        if bad_shards or bad_tags:
+            res.inconsistent[oid] = {"bad_shards": bad_shards,
+                                     "bad_tags": bad_tags}
             if repair:
-                for shard in bad_shards:
+                for shard in bad_shards + bad_tags:
                     osd_id = pg.acting[shard]
+                    blob = canonical[shard].tobytes()
                     payload = {"pgid": pg.pgid, "oid": oid,
                                "absent": False,
-                               "xattrs": {SIZE_XATTR:
-                                          str(size).encode().hex()},
+                               "shard": shard,
+                               "crc": shard_crc(blob),
+                               "xattrs": {
+                                   SIZE_XATTR:
+                                       str(size).encode().hex(),
+                                   VER_XATTR:
+                                       f"{ver[0]},{ver[1]}"
+                                       .encode().hex(),
+                                   SHARD_XATTR:
+                                       str(shard).encode().hex(),
+                                   CRC_XATTR:
+                                       str(shard_crc(blob))
+                                       .encode().hex()},
                                "omap": {}}
                     if osd_id == pg.whoami:
-                        pg._apply_recovery_payload(
-                            oid, payload,
-                            [canonical[shard].tobytes()])
+                        pg._apply_recovery_payload(oid, payload,
+                                                   [blob])
                     else:
                         await pg.osd.fanout_and_wait(
-                            [(osd_id, "pg_push", payload,
-                              [canonical[shard].tobytes()])],
+                            [(osd_id, "pg_push", payload, [blob])],
                             collect=True, timeout=10)
                 res.repaired.append(oid)
     return res

@@ -1,0 +1,393 @@
+"""Cluster fault-injection driver: write -> kill -> re-peer -> verify.
+
+The qa/tasks thrasher in miniature, built on the in-process cluster
+(mon + N OSDs on loopback).  Each round writes a seeded working set to
+an EC pool, kills one OSD (optionally with messenger faults armed via
+common/faults.py), waits for the mon to mark it down, reads EVERY
+object back under a deadline and byte-compares against what was
+written, then (optionally) revives the OSD and verifies recovery
+converges.  Shard mislabeling, wedged degraded reads and recovery
+corruption all surface as hard failures here instead of in production.
+
+CI smoke:  python -m ceph_tpu.tools.chaos --smoke
+exits non-zero on any byte mismatch, wedged read, or lost object.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+import sys
+import time
+
+from ..common.faults import MessageFaultInjector
+from ..mon import Monitor
+from ..msg import Message, Messenger
+from ..osd import OSD
+from ..osd.backend import pack_mutations
+
+
+class ChaosCluster:
+    """Mon + N OSDs + a client messenger, with kill/revive helpers."""
+
+    def __init__(self, mon, osds, client,
+                 faults: MessageFaultInjector | None = None) -> None:
+        self.mon = mon
+        self.osds = osds
+        self.client = client
+        self.faults = faults
+        self._op_serial = 0
+
+    @classmethod
+    async def create(cls, n_osds: int = 3, *,
+                     mon_config: dict | None = None,
+                     osd_config: dict | None = None,
+                     faults: MessageFaultInjector | None = None
+                     ) -> "ChaosCluster":
+        mon = Monitor(rank=0, config={"mon_osd_min_down_reporters": 1,
+                                      **(mon_config or {})})
+        addr = await mon.start()
+        mon.peer_addrs = [addr]
+        osds = []
+        for i in range(n_osds):
+            osd = OSD(host=f"host{i}", config=osd_config,
+                      fault_injector=faults)
+            await osd.start(addr)
+            osds.append(osd)
+        client = Messenger("client.chaos")
+        await client.bind()
+        return cls(mon, osds, client, faults)
+
+    async def stop(self) -> None:
+        for o in self.osds:
+            await o.stop()
+        await self.client.shutdown()
+        await self.mon.stop()
+
+    # -- control plane -------------------------------------------------------
+    async def command(self, cmd: str, args: dict | None = None) -> dict:
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def d(conn, msg):
+            if msg.type == "mon_command_reply":
+                await q.put(msg.data)
+
+        self.client.add_dispatcher(d)
+        try:
+            await self.client.send(
+                self.mon.msgr.addr, "mon.0",
+                Message("mon_command", {"cmd": cmd, "args": args or {}}))
+            data = await asyncio.wait_for(q.get(), 10)
+        finally:
+            self.client.dispatchers.remove(d)
+        if not data["ok"]:
+            raise RuntimeError(data["error"])
+        return data["result"]
+
+    async def create_ec_pool(self, name: str, k: int, m: int,
+                             pg_num: int) -> None:
+        await self.command("osd erasure-code-profile set", {
+            "name": f"chaos-k{k}m{m}",
+            "profile": {"plugin": "tpu", "k": str(k), "m": str(m),
+                        "technique": "reed_sol_van"}})
+        await self.command("osd pool create", {
+            "name": name, "type": "erasure", "pg_num": pg_num,
+            "erasure_code_profile": f"chaos-k{k}m{m}"})
+
+    # -- data plane ----------------------------------------------------------
+    def _target_for(self, pool_name: str, oid: str):
+        omap = self.mon.osdmap
+        pool_id = omap.pool_names[pool_name]
+        _, ps = omap.object_to_pg(pool_id, oid)
+        up = omap.pg_to_up_acting_osds(pool_id, ps)
+        return omap.pg_name(pool_id, ps), omap.pg_primary(up)
+
+    async def osd_op(self, pool_name: str, oid: str, ops: list[dict],
+                     timeout: float = 15.0, retries: int = 40):
+        """One client op against the current primary, retrying through
+        peering; the stable reqid keeps retries idempotent."""
+        q: asyncio.Queue = asyncio.Queue()
+        self._op_serial += 1
+        tid = self._op_serial
+        reqid = [f"{self.client.name}:{self.client.incarnation}", tid]
+
+        async def d(conn, msg):
+            if msg.type == "osd_op_reply" and msg.data.get("tid") == tid:
+                await q.put(msg)
+
+        self.client.add_dispatcher(d)
+        try:
+            for _ in range(retries):
+                pgid, primary = self._target_for(pool_name, oid)
+                if primary is None:
+                    await asyncio.sleep(0.25)
+                    continue
+                addr = self.mon.osdmap.osds[primary].addr
+                meta, segs = pack_mutations(ops)
+                try:
+                    await self.client.send(
+                        tuple(addr), f"osd.{primary}",
+                        Message("osd_op",
+                                {"pgid": pgid, "oid": oid, "ops": meta,
+                                 "reqid": reqid, "tid": tid},
+                                segments=segs))
+                    reply = await asyncio.wait_for(q.get(), timeout)
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    await asyncio.sleep(0.25)
+                    continue
+                err = reply.data.get("err")
+                if err in ("ENOTPRIMARY", "EAGAIN", "ENXIO no such pg"):
+                    await asyncio.sleep(0.25)
+                    continue
+                return reply
+            raise TimeoutError(f"osd_op on {oid} never succeeded")
+        finally:
+            self.client.dispatchers.remove(d)
+
+    # -- fault actions -------------------------------------------------------
+    async def kill_osd(self, index: int) -> dict:
+        """Stop an OSD, keeping what a revive needs."""
+        osd = self.osds[index]
+        token = {"uuid": osd.uuid, "whoami": osd.whoami,
+                 "store": osd.store, "host": osd.host,
+                 "config": dict(osd._base_config)}
+        await osd.stop()
+        return token
+
+    async def revive_osd(self, index: int, token: dict) -> None:
+        osd = OSD(uuid=token["uuid"], whoami=token["whoami"],
+                  store=token["store"], host=token["host"],
+                  config=token["config"], fault_injector=self.faults)
+        await osd.start(self.mon.msgr.addr)
+        self.osds[index] = osd
+
+    async def wait_down(self, osd_id: int, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self.mon.osdmap.is_up(osd_id):
+                return True
+            await asyncio.sleep(0.2)
+        return False
+
+    async def wait_up(self, osd_id: int, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.mon.osdmap.is_up(osd_id):
+                return True
+            await asyncio.sleep(0.2)
+        return False
+
+    async def wait_clean(self, timeout: float = 30.0) -> bool:
+        """Best-effort wait until no primary has pending recovery (the
+        thrasher's wait-for-clean between actions): killing an OSD
+        while a laggard re-push is still in flight tests the durability
+        floor, not the read path."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            busy = False
+            for osd in self.osds:
+                for pg in osd.pgs.values():
+                    if not pg.is_primary():
+                        continue
+                    if pg.state != "active" or pg._recovery_pending():
+                        busy = True
+                        break
+                if busy:
+                    break
+            if not busy:
+                return True
+            await asyncio.sleep(0.2)
+        return False
+
+    def perf_counters(self, which: str) -> dict:
+        """Aggregated counter set across live OSDs (e.g. 'ec_degraded',
+        'fault_inject')."""
+        out: dict[str, int] = {}
+        for osd in self.osds:
+            pc = osd.perf.get(which)
+            if pc is None:
+                continue
+            for key, val in pc.dump().items():
+                if isinstance(val, (int, float)):
+                    out[key] = out.get(key, 0) + val
+        return out
+
+
+async def run_round(c: ChaosCluster, *, rnd: random.Random,
+                    pool: str, n_objects: int, min_size: int,
+                    max_size: int, kill_index: int,
+                    read_deadline: float, revive: bool,
+                    log) -> dict:
+    """One write -> kill -> re-peer -> read-back loop.  Returns a
+    result dict with mismatch/wedge/error lists."""
+    result = {"mismatched": [], "wedged": [], "refused": [],
+              "errors": [], "n_objects": n_objects}
+    objs: dict[str, bytes] = {}
+    for i in range(n_objects):
+        size = rnd.randrange(min_size, max_size + 1)
+        data = rnd.getrandbits(8 * size).to_bytes(size, "little")
+        oid = f"chaos-{i:04d}"
+        objs[oid] = data
+        # writefull: REPLACE semantics, so later rounds overwriting a
+        # longer object from an earlier round don't leave a stale tail
+        # that would read as a false mismatch
+        await c.osd_op(pool, oid, [{"op": "writefull", "data": data}])
+    # let laggard-healing re-pushes settle: every shard of every ack'd
+    # write should be on disk before we pull an OSD out
+    if not await c.wait_clean():
+        result["errors"].append("cluster never went clean pre-kill")
+    victim_id = c.osds[kill_index].whoami
+    log(f"  wrote {n_objects} objects; killing osd.{victim_id}")
+    token = await c.kill_osd(kill_index)
+    if not await c.wait_down(victim_id):
+        result["errors"].append(f"osd.{victim_id} never marked down")
+        return result
+    log(f"  osd.{victim_id} down; reading back under "
+        f"{read_deadline:.0f}s deadline")
+    for oid, want in objs.items():
+        try:
+            reply = await asyncio.wait_for(
+                c.osd_op(pool, oid,
+                         [{"op": "read", "off": 0, "len": None}],
+                         timeout=10, retries=8),
+                timeout=read_deadline)
+        except (TimeoutError, asyncio.TimeoutError):
+            result["wedged"].append(oid)
+            continue
+        if reply.data.get("err"):
+            result["refused"].append(oid)
+            continue
+        r = reply.data["results"][0]
+        data = reply.segments[r["seg"]] if "seg" in r else None
+        if not r.get("ok"):
+            # per-op error (e.g. EIO after bounded shard retries): the
+            # read COMPLETED with a refusal -- bytes were never faked
+            result["refused"].append(oid)
+        elif data != want:
+            result["mismatched"].append(oid)
+    if revive:
+        log(f"  reviving osd.{victim_id}")
+        await c.revive_osd(kill_index, token)
+        if not await c.wait_up(victim_id):
+            result["errors"].append(f"osd.{victim_id} never came back")
+    return result
+
+
+async def chaos_main(args) -> int:
+    rnd = random.Random(args.seed)
+    faults = None
+    if args.msg_drop_p > 0 or args.msg_delay > 0:
+        faults = MessageFaultInjector(seed=args.seed)
+        if args.msg_drop_p > 0:
+            faults.drop(peer="osd.", probability=args.msg_drop_p)
+        if args.msg_delay > 0:
+            faults.delay(args.msg_delay, peer="osd.",
+                         probability=args.msg_delay_p)
+    c = await ChaosCluster.create(
+        args.osds,
+        mon_config={"mon_osd_down_out_interval": 3600.0},
+        osd_config={"osd_heartbeat_interval": 0.2,
+                    "osd_heartbeat_grace": 3.0},
+        faults=faults)
+    failures = 0
+
+    def log(msg: str) -> None:
+        if not args.quiet:
+            print(msg, flush=True)
+
+    try:
+        await c.create_ec_pool("chaospool", args.k, args.m, args.pg_num)
+        for r in range(args.rounds):
+            log(f"round {r + 1}/{args.rounds}")
+            kill_index = (len(c.osds) - 1 if args.kill_last
+                          else rnd.randrange(len(c.osds)))
+            res = await run_round(
+                c, rnd=rnd, pool="chaospool",
+                n_objects=args.objects, min_size=args.min_size,
+                max_size=args.max_size, kill_index=kill_index,
+                read_deadline=args.read_deadline,
+                revive=(r + 1 < args.rounds), log=log)
+            bad = (len(res["mismatched"]) + len(res["wedged"])
+                   + len(res["errors"]))
+            # an EIO refusal is a failure only on a clean network: with
+            # drop faults armed, a write ack'd at min_size can lose a
+            # shard to the kill before the re-push lands -- the honest
+            # outcome is a refused read, never fabricated bytes
+            if faults is None or args.strict_reads:
+                bad += len(res["refused"])
+            failures += bad
+            log(f"  result: {res['n_objects'] - bad}/{res['n_objects']}"
+                f" clean, mismatched={res['mismatched']}, "
+                f"wedged={res['wedged']}, refused={res['refused']}, "
+                f"errors={res['errors']}")
+        deg = c.perf_counters("ec_degraded")
+        log(f"ec_degraded counters: {deg}")
+        if faults is not None:
+            log(f"fault_inject stats: {faults.stats}")
+        if not deg.get("degraded_reads") and not args.allow_clean:
+            # reading back with a dead shard holder MUST have exercised
+            # reconstruction; a zero here means the drive tested nothing
+            log("ERROR: no degraded reads recorded -- harness broken?")
+            failures += 1
+    finally:
+        await c.stop()
+    log(f"{'FAIL' if failures else 'PASS'}: {failures} failures")
+    return 1 if failures else 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="EC cluster fault-injection driver")
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--objects", type=int, default=24)
+    p.add_argument("--osds", type=int, default=3)
+    p.add_argument("--k", type=int, default=2)
+    p.add_argument("--m", type=int, default=1)
+    p.add_argument("--pg-num", type=int, default=16)
+    p.add_argument("--min-size", type=int, default=8 << 10)
+    p.add_argument("--max-size", type=int, default=32 << 10)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--kill-last", action="store_true",
+                   help="always kill the last OSD (the ROADMAP repro) "
+                        "instead of a seeded random victim")
+    p.add_argument("--read-deadline", type=float, default=60.0,
+                   help="per-object read-back deadline; exceeding it "
+                        "counts as a wedged read")
+    p.add_argument("--msg-drop-p", type=float, default=0.0,
+                   help="drop probability for osd<->osd messages")
+    p.add_argument("--msg-delay", type=float, default=0.0,
+                   help="injected delay seconds for osd<->osd messages")
+    p.add_argument("--msg-delay-p", type=float, default=0.2)
+    p.add_argument("--allow-clean", action="store_true",
+                   help="don't fail when no degraded read was recorded")
+    p.add_argument("--strict-reads", action="store_true",
+                   help="count EIO-refused reads as failures even "
+                        "with message faults armed")
+    p.add_argument("--quiet", action="store_true")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI mode: one round, kill-last, fixed seed")
+    return p
+
+
+def apply_smoke_overrides(args):
+    """--smoke pins the CI configuration: one deterministic kill-last
+    round; any byte mismatch/wedge exits non-zero."""
+    if args.smoke:
+        args.rounds = 1
+        args.kill_last = True
+        args.seed = 7
+    return args
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = apply_smoke_overrides(build_parser().parse_args(argv))
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(chaos_main(args))
+    finally:
+        loop.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

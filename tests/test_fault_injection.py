@@ -1,0 +1,147 @@
+"""MessageFaultInjector: deterministic schedules, rule matching,
+partitions, and messenger integration (common/faults.py)."""
+
+import asyncio
+
+from ceph_tpu.common.faults import RECV, SEND, MessageFaultInjector
+from ceph_tpu.msg import Message, Messenger
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _schedule(inj: MessageFaultInjector, n: int = 200):
+    """Feed a fixed message sequence; record every decision."""
+    out = []
+    for i in range(n):
+        peer = f"osd.{i % 4}"
+        mtype = ("osd_ping", "ec_subop_read", "pg_push")[i % 3]
+        d = inj.decide(SEND if i % 2 else RECV, "osd.9", peer, mtype)
+        out.append((d.drop, round(d.delay, 6), d.copies))
+    return out
+
+
+def test_same_seed_same_schedule():
+    """The tentpole property: a chaos run is REPLAYABLE from its seed."""
+    def arm(inj):
+        inj.drop(peer="osd.", probability=0.3)
+        inj.delay(0.05, mtype="pg_push", probability=0.5)
+        inj.duplicate(mtype="osd_ping", probability=0.2)
+
+    a, b = MessageFaultInjector(seed=42), MessageFaultInjector(seed=42)
+    arm(a)
+    arm(b)
+    sched_a, sched_b = _schedule(a), _schedule(b)
+    assert sched_a == sched_b
+    assert a.stats == b.stats
+    assert a.stats.get("dropped", 0) > 0          # faults actually fired
+    # a different seed produces a different schedule
+    c = MessageFaultInjector(seed=43)
+    arm(c)
+    assert _schedule(c) != sched_a
+
+
+def test_unrelated_traffic_does_not_shift_schedule():
+    """The RNG is consumed only by matching probabilistic rules, so
+    extra unmatched messages cannot perturb the flow under test."""
+    def arm(inj):
+        inj.drop(peer="osd.1", probability=0.5)
+
+    a, b = MessageFaultInjector(seed=7), MessageFaultInjector(seed=7)
+    arm(a)
+    arm(b)
+    decisions_a = [a.decide(SEND, "x", "osd.1", "m").drop
+                   for _ in range(50)]
+    decisions_b = []
+    for _ in range(50):
+        b.decide(SEND, "x", "mon.0", "m")       # unmatched interleave
+        decisions_b.append(b.decide(SEND, "x", "osd.1", "m").drop)
+    assert decisions_a == decisions_b
+
+
+def test_rule_matching_and_countdown():
+    inj = MessageFaultInjector(seed=1)
+    rule = inj.drop(peer="osd.3", mtype="pg_push", direction=SEND,
+                    count=2)
+    # exact peer match: osd.30 must NOT alias osd.3
+    assert not inj.decide(SEND, "me", "osd.30", "pg_push").drop
+    # wrong type / wrong direction: no fire
+    assert not inj.decide(SEND, "me", "osd.3", "pg_pull").drop
+    assert not inj.decide(RECV, "me", "osd.3", "pg_push").drop
+    # fires exactly `count` times, then exhausts
+    assert inj.decide(SEND, "me", "osd.3", "pg_push").drop
+    assert inj.decide(SEND, "me", "osd.3", "pg_push").drop
+    assert not inj.decide(SEND, "me", "osd.3", "pg_push").drop
+    assert rule.fired == 2
+    # prefix match: "osd." hits every osd
+    inj.delay(0.1, peer="osd.")
+    assert inj.decide(SEND, "me", "osd.17", "anything").delay == 0.1
+    assert inj.decide(SEND, "me", "mon.0", "anything").delay == 0.0
+
+
+def test_partition_and_heal():
+    inj = MessageFaultInjector(seed=0)
+    inj.partition("osd.1", "osd.2")
+    assert inj.decide(SEND, "osd.1", "osd.2", "osd_ping").drop
+    assert inj.decide(SEND, "osd.2", "osd.1", "osd_ping").drop   # both ways
+    assert not inj.decide(SEND, "osd.1", "osd.3", "osd_ping").drop
+    inj.heal("osd.1", "osd.2")
+    assert not inj.decide(SEND, "osd.1", "osd.2", "osd_ping").drop
+    # group partition: every osd cut off from the mon
+    inj.partition("osd.", "mon.0")
+    assert inj.decide(SEND, "osd.7", "mon.0", "sub_osdmap").drop
+    assert inj.decide(RECV, "mon.0", "osd.7", "osd_boot").drop
+    inj.heal()
+    assert not inj.decide(SEND, "osd.7", "mon.0", "sub_osdmap").drop
+
+
+def test_messenger_send_drop_and_duplicate():
+    """End-to-end through two real messengers on loopback."""
+    async def main():
+        inj = MessageFaultInjector(seed=5)
+        a = Messenger("client.a", faults=inj)
+        b = Messenger("svc.b")
+        await a.bind()
+        addr = await b.bind()
+        got: asyncio.Queue = asyncio.Queue()
+
+        async def d(conn, msg):
+            if msg.type == "probe":
+                await got.put(msg.data["n"])
+
+        b.add_dispatcher(d)
+        try:
+            # one-shot drop: first probe vanishes, second arrives
+            inj.drop(peer="svc.b", mtype="probe", count=1)
+            await a.send(addr, "svc.b", Message("probe", {"n": 1}))
+            await a.send(addr, "svc.b", Message("probe", {"n": 2}))
+            first = await asyncio.wait_for(got.get(), 5)
+            assert first == 2, "dropped message was delivered"
+            assert inj.stats.get("dropped") == 1
+            # duplication: one send, two deliveries
+            inj.duplicate(peer="svc.b", mtype="probe", count=1)
+            await a.send(addr, "svc.b", Message("probe", {"n": 3}))
+            assert await asyncio.wait_for(got.get(), 5) == 3
+            assert await asyncio.wait_for(got.get(), 5) == 3
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+    run(main())
+
+
+def test_chaos_cli_smoke_flag():
+    """--smoke pins the CI configuration (one round, kill-last,
+    fixed seed) without touching the other knobs."""
+    from ceph_tpu.tools.chaos import apply_smoke_overrides, build_parser
+    ns = apply_smoke_overrides(
+        build_parser().parse_args(["--smoke", "--objects", "5"]))
+    assert (ns.rounds, ns.kill_last, ns.seed, ns.objects) == \
+        (1, True, 7, 5)
+    # without --smoke the defaults stand
+    ns = apply_smoke_overrides(build_parser().parse_args([]))
+    assert ns.rounds == 3 and not ns.kill_last

@@ -16,6 +16,11 @@ Secondary configs (each its own entry under "configs"):
   * crush_10m: 10M PG->OSD straw2 mappings over a 1000-OSD map
     (vectorized placement; value in M mappings/s).
 
+Modes: --osd-path drives the OSD data path (see _osd_path_mode);
+--placement measures the epoch-memoized placement cache -- bulk
+epoch-recompute throughput (pg/s) vs the per-PG scalar loop plus
+cached lookup latency (--smoke = tier-1 fused-parity tripwire).
+
 vs_baseline is the repo's own native C++ AVX2 encoder (native/gf8.cc,
 ISA-L's split-nibble SIMD technique, single thread) -- stated plainly:
 this is an ISA-L-technique reimplementation, not a linked ISA-L build
@@ -388,6 +393,136 @@ def _save_interim() -> None:
         log(f"interim save failed: {e}")
 
 
+def _make_placement_map(fanouts, pg_num, down_frac=0.05, seed=11):
+    """Synthetic OSDMap for placement benchmarking: a uniform straw2
+    hierarchy, one replicated + one EC pool, a sprinkle of down OSDs,
+    upmap items and a pg_temp override -- every branch of the cached
+    pipeline is on the clock."""
+    import random
+    from ceph_tpu.crush.builder import build_hierarchy
+    from ceph_tpu.mon.osdmap import (
+        OSDMap, OsdInfo, PoolSpec, POOL_TYPE_ERASURE)
+
+    rnd = random.Random(seed)
+    n = 1
+    for f in fanouts:
+        n *= f
+    m = OSDMap()
+    m.epoch = 1
+    m.crush = build_hierarchy(fanouts)
+    m.max_osd = n
+    for o in range(n):
+        m.osds[o] = OsdInfo(up=(rnd.random() >= down_frac),
+                            in_cluster=True, weight=0x10000)
+    for pid, (name, extra) in enumerate((
+            ("rep", {}),
+            ("ecpool", {"type": POOL_TYPE_ERASURE, "size": 4,
+                        "min_size": 3, "crush_rule": 1}),), start=1):
+        spec = PoolSpec(pool_id=pid, name=name, pg_num=pg_num,
+                        pgp_num=pg_num, **extra)
+        m.pools[pid] = spec
+        m.pool_names[name] = pid
+    # overrides: a few upmap rewrites and one pg_temp per pool
+    ups = [o for o, i in m.osds.items() if i.up]
+    for pid in m.pools:
+        for pg in range(0, min(pg_num, 64), 7):
+            m.pg_upmap_items[f"{pid}.{pg:x}"] = [
+                (rnd.choice(ups), rnd.choice(ups))]
+        m.pg_temp[f"{pid}.1"] = rnd.sample(ups, 3)
+    return m
+
+
+def _placement_mode(deadline: float, smoke: bool) -> int:
+    """--placement: epoch-recompute throughput (pg/s) of the bulk
+    placement cache vs the per-PG scalar pg_to_up_acting loop, plus
+    per-op cached lookup latency.  Parity is asserted before timing --
+    entry-identical tables or no number."""
+    from ceph_tpu.mon.pg_mapping import PGMapping
+
+    if smoke:
+        fanouts, pg_num = [4, 8], 256
+        # the smoke's whole point is fused-vs-scalar divergence failing
+        # fast: force the fused path even at toy lane counts
+        import ceph_tpu.mon.pg_mapping as _pgm
+        _pgm.FUSED_MIN_LANES = 1
+    else:
+        fanouts = [int(x) for x in os.environ.get(
+            "BENCH_PLACE_FANOUTS", "8,8,8").split(",")]
+        pg_num = int(os.environ.get("BENCH_PLACE_PGS", "16384"))
+    m = _make_placement_map(fanouts, pg_num)
+    total = pg_num * len(m.pools)
+    log(f"placement mode: {len(m.osds)} osds, {len(m.pools)} pools x "
+        f"{pg_num} pgs ({total} table entries), smoke={smoke}")
+
+    # parity gate: the fused bulk table must equal the scalar oracle
+    # entry-for-entry on a sample (the full suite lives in
+    # tests/test_placement_cache.py; the bench re-asserts a slice so a
+    # drifted build can never publish a throughput number)
+    pm = PGMapping.build(m, fused="always" if smoke else "auto")
+    fused = pm.scalar_pools == 0
+    rng = np.random.default_rng(3)
+    for pid in m.pools:
+        for ps in rng.integers(0, pg_num * 4, size=48 if smoke else 24):
+            want = m._pg_to_up_acting_scalar(pid, int(ps))
+            got = pm.lookup(pid, int(ps))
+            if got != want:
+                raise RuntimeError(
+                    f"placement parity failure pool {pid} ps {ps}: "
+                    f"cached {got} != scalar {want}")
+    log(f"parity gate passed (fused_path={fused})")
+
+    # scalar baseline: the pre-cache per-PG loop, sampled + extrapolated
+    sample = min(total, 256 if smoke else 1024)
+    pids = sorted(m.pools)
+    t0 = time.perf_counter()
+    for i in range(sample):
+        m._pg_to_up_acting_scalar(pids[i % len(pids)],
+                                  i // len(pids))
+    scalar_dt = time.perf_counter() - t0
+    scalar_pgs = sample / scalar_dt
+    log(f"scalar loop: {scalar_pgs:.0f} pg/s "
+        f"({sample} pgs in {scalar_dt:.2f}s)")
+
+    # bulk recompute, steady state: first build above warmed the jit
+    # caches; each timed round invalidates and rebuilds the whole
+    # table, exactly what a new epoch costs
+    iters = 2 if smoke else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m.invalidate_placement_cache()
+        pm = m.placement_cache()
+    bulk_dt = (time.perf_counter() - t0) / iters
+    bulk_pgs = total / bulk_dt
+    log(f"bulk recompute: {bulk_pgs:.0f} pg/s "
+        f"({bulk_dt * 1e3:.1f} ms/epoch, {iters} epochs)")
+
+    lookups = 20000 if smoke else 200000
+    t0 = time.perf_counter()
+    for i in range(lookups):
+        m.pg_to_up_acting(pids[i & 1], i % pg_num)
+    lookup_us = (time.perf_counter() - t0) / lookups * 1e6
+    log(f"cached lookup: {lookup_us:.2f} us/op")
+
+    ratio = bulk_pgs / scalar_pgs
+    RESULT.update({
+        "metric": "placement_epoch_recompute_pgs_per_s",
+        "value": round(bulk_pgs, 1),
+        "unit": "pg/s",
+        "vs_baseline": round(ratio, 2),
+        "scalar_pgs_per_s": round(scalar_pgs, 1),
+        "lookup_us": round(lookup_us, 3),
+        "fused_path": fused,
+        "table_entries": total,
+        "osds": len(m.osds),
+        "smoke": smoke,
+    })
+    emit()
+    if smoke and not fused:
+        log("ERROR: smoke demands the fused path")
+        return 1
+    return 0
+
+
 def _osd_path_mode(deadline: float) -> int:
     """--osd-path: drive the OSD DATA PATH — concurrent client EC
     writes through an in-process mon+OSD cluster — instead of the raw
@@ -430,6 +565,8 @@ def main() -> int:
 
     if "--osd-path" in sys.argv[1:] or os.environ.get("BENCH_OSD_PATH"):
         return _osd_path_mode(deadline)
+    if "--placement" in sys.argv[1:] or os.environ.get("BENCH_PLACEMENT"):
+        return _placement_mode(deadline, "--smoke" in sys.argv[1:])
 
     log("probing backend reachability (child process, retry loop)")
     if not _backend_reachable(deadline):

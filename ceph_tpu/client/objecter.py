@@ -95,6 +95,8 @@ class Objecter:
             # a slow full-map reply must not regress past incrementals
             # _dispatch applied while we waited
             if new_map.epoch >= self.osdmap.epoch:
+                # placement counters are per-client, not per-map object
+                new_map._placement_perf = self.osdmap._placement_perf
                 self.osdmap = new_map
         finally:
             self.msgr.dispatchers.remove(d)
@@ -199,6 +201,12 @@ class Objecter:
 
         Pass ``ps`` to target a specific PG (pgls-style ops that
         address a placement group, not an object).
+
+        No CRUSH runs here: pg_to_up_acting_osds reads the epoch-
+        memoized placement table (mon/pg_mapping.py), recomputed in
+        bulk only when a new map epoch lands — per-op cost no longer
+        scales with map size, and a hot client does zero placement
+        math between epochs.
         """
         if ps is None:
             _, ps = self.osdmap.object_to_pg(pool_id, oid, nspace)

@@ -104,6 +104,13 @@ class PerfCountersCollection:
             pc = self._sets[name] = PerfCounters(name)
         return pc
 
+    def adopt(self, pc: PerfCounters) -> PerfCounters:
+        """Register an externally-owned counter set under its own name
+        (e.g. the OSDMap's placement_cache counters, which live and
+        die with the map object) so dump() and get() cover it."""
+        self._sets[pc.name] = pc
+        return pc
+
     def get(self, name: str) -> PerfCounters | None:
         return self._sets.get(name)
 

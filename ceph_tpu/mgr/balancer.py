@@ -6,8 +6,10 @@ find the most over/under-full devices, and emit (from, to) upmap items
 that move single replicas while respecting the failure domain (no two
 replicas of a pg on one host).  The full-cluster mapping recompute is
 the `OSDMapMapping`/ParallelPGMapper job (src/osd/OSDMapMapping.h:175)
--- here it is one vectorized CRUSH launch over every (pool, ps) when
-the map fits the fused path, with the scalar engine as fallback.
+-- served here by the shared epoch-memoized placement cache
+(ceph_tpu/mon/pg_mapping.py): one vectorized CRUSH launch over every
+(pool, ps) when the map fits the fused path, a batched scalar sweep
+otherwise, identical to what clients are routed by.
 """
 
 from __future__ import annotations
@@ -30,37 +32,23 @@ def _osd_hosts(osdmap) -> dict[int, int]:
 
 
 def full_mapping(osdmap) -> dict[str, list[int]]:
-    """pgid -> mapped osds for every pg of every pool, via the
-    vectorized mapper when the (map, rule) compiles for it."""
-    out: dict[str, list[int]] = {}
-    weights = osdmap.osd_weights()
-    for pool_id, pool in osdmap.pools.items():
-        pss = np.arange(pool.pg_num)
-        pps = np.array([pool.raw_pg_to_pps(int(ps)) for ps in pss],
-                       dtype=np.int64)
-        rows = None
-        try:
-            from ..crush.vectorized import VectorCrush
-            vc = VectorCrush(osdmap.crush, pool.crush_rule)
-            rows = vc.map_pgs(pps, pool.size, weights)
-        except ValueError:
-            pass                      # shape outside the fused path
-        if rows is None:
-            from ..crush import crush_do_rule
-            rows = [crush_do_rule(osdmap.crush, pool.crush_rule,
-                                  int(x), pool.size, weights)
-                    for x in pps]
-        for ps, row in zip(pss, rows):
-            pgid = osdmap.pg_name(pool_id, int(ps))
-            out[pgid] = osdmap._apply_upmap(pgid, [int(o) for o in row])
-    return out
+    """pgid -> UP set for every pg of every pool, straight from the
+    epoch-memoized placement cache (mon/pg_mapping.py).
+
+    This used to run its own CRUSH sweep WITHOUT the upmap/down-osd
+    filtering clients apply, so the balancer scored a mapping nobody
+    was actually served from.  Now it reads the exact table
+    Objecter.calc_target reads (holes are -1 after normalization)."""
+    return {f"{pool_id}.{pg:x}": list(up)
+            for pool_id, pg, up, _acting
+            in osdmap.placement_cache().iter_all()}
 
 
 def _counts_of(mapping, eligible) -> dict[int, int]:
     counts: dict[int, int] = defaultdict(int)
     for osds in mapping.values():
         for o in osds:
-            if o != CRUSH_ITEM_NONE:
+            if 0 <= o != CRUSH_ITEM_NONE:
                 counts[o] += 1
     for o in eligible:
         counts.setdefault(o, 0)
@@ -113,7 +101,7 @@ def balance(osdmap, max_moves: int = 10) -> dict:
             if high not in osds or low in osds or pgid in plans:
                 continue
             others = [o for o in osds
-                      if o not in (high, CRUSH_ITEM_NONE)]
+                      if o >= 0 and o not in (high, CRUSH_ITEM_NONE)]
             if hosts.get(low) in {hosts.get(o) for o in others}:
                 continue              # would stack replicas on a host
             plans[pgid] = [(high, low)]

@@ -8,4 +8,5 @@ services over the v2-lite messenger).
 """
 
 from .osdmap import OSDMap, PoolSpec, Incremental  # noqa: F401
+from .pg_mapping import PGMapping  # noqa: F401
 from .monitor import Monitor  # noqa: F401

@@ -226,6 +226,14 @@ class OSDMap:
         self.pool_names: dict[str, int] = {}
         self.crush = CrushMap()
         self.ec_profiles: dict[str, dict] = {}
+        # placement cache plumbing (mon/pg_mapping.py): every mutation
+        # entry point bumps _mutation_gen, and the memoized full-
+        # cluster table + weight vector are keyed on it -- a stale-
+        # generation read is structurally impossible
+        self._mutation_gen = 0
+        self._pcache: tuple[int, Any] | None = None
+        self._weights_memo: tuple[int, list[int]] | None = None
+        self._placement_perf = None
         # explicit placement overrides (OSDMap.cc:2705 _apply_upmap /
         # pg_temp): upmap items rewrite the raw CRUSH result (balancer
         # output), pg_temp overrides the ACTING set only (serving
@@ -268,14 +276,60 @@ class OSDMap:
         re-run CRUSH without it and RESHUFFLE the raw placement -- for
         EC pools the acting-set position IS the shard id, so a reshuffle
         relabels every surviving OSD's stored shard bytes (the
-        degraded-read corruption pinned by tests/test_ec_degraded.py)."""
+        degraded-read corruption pinned by tests/test_ec_degraded.py).
+
+        Memoized per mutation generation (the vector used to be
+        rebuilt over max_osd on EVERY pg_to_up_acting call); callers
+        treat the returned list as read-only."""
+        if (self._weights_memo is not None
+                and self._weights_memo[0] == self._mutation_gen):
+            return self._weights_memo[1]
         n = max([self.max_osd] + [o + 1 for o in self.osds]) if self.osds \
             else self.max_osd
         w = [0] * n
         for osd, info in self.osds.items():
             if info.in_cluster:
                 w[osd] = info.weight
+        self._weights_memo = (self._mutation_gen, w)
         return w
+
+    # -- placement cache ----------------------------------------------------
+    @property
+    def placement_perf(self):
+        """This map's 'placement_cache' counter set (bulk_recomputes,
+        fused/scalar pools, recompute time, lookups, delta_pgs).
+        Daemons adopt it into their PerfCountersCollection so `perf
+        dump` and the chaos driver see it."""
+        if self._placement_perf is None:
+            from ..common.perf import PerfCounters
+            self._placement_perf = PerfCounters("placement_cache")
+        return self._placement_perf
+
+    def peek_placement_cache(self):
+        """The built PGMapping for the CURRENT generation, or None --
+        never triggers a build (map-change handlers capture the
+        previous table for delta() before applying an incremental)."""
+        if (self._pcache is not None
+                and self._pcache[0] == self._mutation_gen):
+            return self._pcache[1]
+        return None
+
+    def placement_cache(self):
+        """The full-cluster placement table for this epoch, building
+        it (one bulk recompute) on first use per mutation generation."""
+        cached = self.peek_placement_cache()
+        if cached is not None:
+            return cached
+        from .pg_mapping import PGMapping
+        pm = PGMapping.build(self, perf=self.placement_perf)
+        self._pcache = (self._mutation_gen, pm)
+        return pm
+
+    def invalidate_placement_cache(self) -> None:
+        """Out-of-band map surgery (tests, offline tools editing
+        fields directly) must call this; apply_incremental and the
+        dict loaders bump the generation themselves."""
+        self._mutation_gen += 1
 
     # -- placement ----------------------------------------------------------
     def object_to_pg(self, pool_id: int, name: str, nspace: str = "",
@@ -306,7 +360,21 @@ class OSDMap:
         """(up, acting) for a pg (OSDMap.cc:2928 _pg_to_up_acting_osds).
 
         up = CRUSH + upmap + down-filter; acting = the pg_temp override
-        when one is set (the serving set during backfill), else up."""
+        when one is set (the serving set during backfill), else up.
+
+        Served from the epoch-memoized full-cluster table (OSDMapMapping
+        analog, mon/pg_mapping.py): CRUSH runs once per map generation
+        in bulk, and this is an O(1) array read.  The per-PG scalar
+        pipeline survives as _pg_to_up_acting_scalar -- the oracle the
+        parity suite holds the table to, entry for entry."""
+        pm = self.placement_cache()
+        if self._placement_perf is not None:
+            self._placement_perf.inc("lookups")
+        return pm.lookup(pool_id, ps)
+
+    def _pg_to_up_acting_scalar(self, pool_id: int,
+                                ps: int) -> tuple[list[int], list[int]]:
+        """Reference per-PG pipeline (one scalar crush_do_rule)."""
         pool = self.pools[pool_id]
         pgid = self.pg_name(pool_id, ps)
         pps = pool.raw_pg_to_pps(pool.raw_pg_to_pg(ps))
@@ -426,6 +494,10 @@ class OSDMap:
             self.pg_upmap_items[pgid] = [tuple(i) for i in items]
         for pgid in inc.removed_pg_upmap_items:
             self.pg_upmap_items.pop(pgid, None)
+        # every incremental -- osd state, weights, pools, crush,
+        # pg_temp, upmap -- retires the memoized placement table and
+        # weight vector for the previous generation
+        self._mutation_gen += 1
 
     # -- serialization ------------------------------------------------------
     def to_dict(self) -> dict:

@@ -123,6 +123,10 @@ class OSD:
         # observability (src/common/perf_counters + TrackedOp analog)
         self.perf = PerfCountersCollection()
         self.perf_osd = self.perf.create("osd")
+        # the map owns the placement-cache counters (they live and die
+        # with it); adopt them so `perf dump` includes the set.  A
+        # full-map ingest re-adopts the fresh map's instance.
+        self.perf.adopt(self.osdmap.placement_perf)
         # cross-PG EC codec aggregation stage: every ECBackend on this
         # OSD funnels encode/decode work through ONE batcher so
         # concurrent ops share accelerator launches
@@ -369,9 +373,20 @@ class OSD:
 
     # -- map handling -------------------------------------------------------
     def _apply_full_map(self, map_dict: dict) -> None:
+        # capture the outgoing table: delta() against it lets the new
+        # map touch only the PGs that actually moved
+        prev = self.osdmap.peek_placement_cache()
+        old_perf = self.osdmap._placement_perf
         self.osdmap = OSDMap.from_dict(map_dict)
+        if old_perf is not None:
+            # counters are per-daemon, not per-map-object: a full-map
+            # ingest must not zero the recompute/delta history
+            self.osdmap._placement_perf = old_perf
+        self.perf.adopt(self.osdmap.placement_perf)
         self._last_map_time = time.monotonic()
-        self._on_map_change()
+        # full-map ingest rebuilds EVERY PoolSpec object, so hosted
+        # PGs must rebind their pool regardless of placement deltas
+        self._on_map_change(prev_cache=prev, rebuilt_pools=None)
 
     def _apply_incremental(self, inc_dict: dict) -> None:
         inc = Incremental.from_dict(inc_dict)
@@ -381,8 +396,10 @@ class OSD:
         if inc.epoch != self.osdmap.epoch + 1:
             self._track(asyncio.ensure_future(self._catch_up_maps()))
             return
+        prev = self.osdmap.peek_placement_cache()
         self.osdmap.apply_incremental(inc)
-        self._on_map_change()
+        self._on_map_change(prev_cache=prev,
+                            rebuilt_pools=set(inc.new_pools))
 
     async def _catch_up_maps(self) -> None:
         try:
@@ -392,31 +409,63 @@ class OSD:
         except (asyncio.TimeoutError, ConnectionError, OSError):
             pass
 
-    def _on_map_change(self) -> None:
-        """Instantiate/retarget PGs after an epoch change."""
+    def _on_map_change(self, prev_cache=None,
+                       rebuilt_pools: set[int] | None = None) -> None:
+        """Instantiate/retarget PGs after an epoch change.
+
+        With the previous epoch's placement table in hand the sweep is
+        delta-driven: only PGs whose up/acting actually moved are
+        visited (PGMapping.delta), so an epoch that merely bumps
+        up_thru or fences a client touches nothing.  Without one (boot,
+        gap catch-up) it walks the whole cached table once.
+
+        ``rebuilt_pools`` names pools whose PoolSpec objects were
+        REPLACED by this map (inc.new_pools); None means all of them
+        (full-map ingest) -- hosted PGs rebind to the live object so
+        snap state et al keep flowing (the old full-sweep did this as
+        a side effect of visiting every PG)."""
         t0 = time.monotonic()
         epoch = self.osdmap.epoch
-        for pool_id, pool in self.osdmap.pools.items():
-            profile = None
-            if pool.is_erasure():
-                profile = self.osdmap.ec_profiles.get(
+        cache = self.osdmap.placement_cache()
+        if rebuilt_pools is None or rebuilt_pools:
+            for pgid, pg in self.pgs.items():
+                pool_id = int(pgid.split(".")[0])
+                if rebuilt_pools is not None \
+                        and pool_id not in rebuilt_pools:
+                    continue
+                live = self.osdmap.pools.get(pool_id)
+                if live is not None:
+                    pg.pool = live
+        if prev_cache is not None:
+            todo = cache.delta(prev_cache,
+                               perf=self.osdmap.placement_perf)
+        else:
+            todo = [(pool_id, pg_no) for pool_id, pg_no, _, _
+                    in cache.iter_all()]
+        profiles: dict[int, dict | None] = {}
+        for pool_id, pg_no in todo:
+            pool = self.osdmap.pools.get(pool_id)
+            if pool is None or pg_no >= pool.pg_num:
+                continue        # deleted pool / shrunk range: dropped below
+            if pool_id not in profiles:
+                profiles[pool_id] = (self.osdmap.ec_profiles.get(
                     pool.erasure_code_profile)
-            for ps in range(pool.pg_num):
-                up, acting = self.osdmap.pg_to_up_acting(pool_id, ps)
-                pgid = self.osdmap.pg_name(pool_id, ps)
-                involved = self.whoami in up or self.whoami in acting
-                pg = self.pgs.get(pgid)
-                if pg is None:
-                    if not involved:
-                        continue
-                    pg = PG(self, pgid, pool, profile)
-                    self.pgs[pgid] = pg
-                # a full-map catch-up builds NEW PoolSpec objects: the
-                # pg must track the live one (removed_snaps et al)
-                pg.pool = pool
-                changed = pg.update_mapping(up, acting, epoch)
-                if changed and pg.is_primary():
-                    pg.kick_peering()
+                    if pool.is_erasure() else None)
+            up, acting = cache.lookup(pool_id, pg_no)
+            pgid = f"{pool_id}.{pg_no:x}"
+            involved = self.whoami in up or self.whoami in acting
+            pg = self.pgs.get(pgid)
+            if pg is None:
+                if not involved:
+                    continue
+                pg = PG(self, pgid, pool, profiles[pool_id])
+                self.pgs[pgid] = pg
+            # a full-map catch-up builds NEW PoolSpec objects: the
+            # pg must track the live one (removed_snaps et al)
+            pg.pool = pool
+            changed = pg.update_mapping(up, acting, epoch)
+            if changed and pg.is_primary():
+                pg.kick_peering()
         # drop PGs for deleted pools
         live_pools = set(self.osdmap.pools)
         for pgid in list(self.pgs):

@@ -330,6 +330,18 @@ async def chaos_main(args) -> int:
             # reconstruction; a zero here means the drive tested nothing
             log("ERROR: no degraded reads recorded -- harness broken?")
             failures += 1
+        # the client routed every op through mon.osdmap's cached table
+        # and each OSD retargeted through its own; kills/re-peering
+        # bump epochs, so zero bulk recomputes means the epoch-keyed
+        # invalidation never fired and the drive read stale placement
+        pc = c.perf_counters("placement_cache")
+        mon_pc = c.mon.osdmap.placement_perf.dump()
+        log(f"placement_cache counters: osds={pc} mon={mon_pc}")
+        if not mon_pc.get("bulk_recomputes") or not pc.get(
+                "bulk_recomputes"):
+            log("ERROR: placement cache never recomputed across the "
+                "kill -- invalidation broken?")
+            failures += 1
     finally:
         await c.stop()
     log(f"{'FAIL' if failures else 'PASS'}: {failures} failures")

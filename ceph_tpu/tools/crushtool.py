@@ -21,7 +21,7 @@ import json
 import sys
 from collections import defaultdict
 
-from ..crush import CrushMap, crush_do_rule
+from ..crush import CrushMap
 from ..crush.types import (
     Bucket, Rule, RuleStep, Tunables,
     CRUSH_BUCKET_UNIFORM, CRUSH_BUCKET_LIST, CRUSH_BUCKET_TREE,
@@ -293,6 +293,11 @@ def decompile(cm: CrushMap, type_names: dict[int, str] | None = None,
 def run_test(cm: CrushMap, ruleno: int, numrep: int, min_x: int,
              max_x: int, weights: dict[int, float],
              show_utilization: bool, out=sys.stdout) -> dict:
+    import numpy as np
+
+    from ..crush.types import CRUSH_ITEM_NONE
+    from ..mon.pg_mapping import bulk_crush
+
     n = max([i for b in cm.buckets.values() for i in b.items
              if i >= 0] + [o for o in weights], default=-1) + 1
     w = [0x10000] * n
@@ -300,8 +305,24 @@ def run_test(cm: CrushMap, ruleno: int, numrep: int, min_x: int,
         w[osd] = int(round(wf * 0x10000))
     counts: dict[int, int] = defaultdict(int)
     sizes: dict[int, int] = defaultdict(int)
-    for x in range(min_x, max_x + 1):
-        res = crush_do_rule(cm, ruleno, x, numrep, w)
+    # the whole x range maps in ONE bulk launch through the same
+    # helper the placement cache rides (fused when the shape compiles
+    # and the range is large enough, scalar sweep otherwise) -- the
+    # simulator exercises the production bulk path, not a private one
+    rule = cm.rules.get(ruleno)
+    firstn = rule is not None and any(
+        s.op in (CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSELEAF_FIRSTN)
+        for s in rule.steps)
+    xs = np.arange(min_x, max_x + 1, dtype=np.int64)
+    rows, _ = bulk_crush(cm, ruleno, xs, numrep, w)
+    for x, row in zip(xs, rows):
+        res = [int(r) for r in row]
+        if firstn:
+            # scalar firstn returns a compacted vector with no NONE
+            # padding; strip it so output matches crush_do_rule's
+            res = [r for r in res if r != CRUSH_ITEM_NONE]
+        elif rule is None:
+            res = []
         print(f"CRUSH rule {ruleno} x {x} {res}", file=out)
         sizes[len([r for r in res if 0 <= r < n])] += 1
         for r in res:

@@ -49,15 +49,16 @@ def cmd_test_map_pgs(m: OSDMap, pool_filter: int | None) -> None:
     counts: dict[int, int] = {}
     total = 0
     sizes: dict[int, int] = {}
-    for pid, pool in sorted(m.pools.items()):
+    # one bulk table build, then array reads -- the exact cached
+    # pipeline (upmap, pg_temp, down-filter) clients are routed by
+    for pid, pg, _up, acting in m.placement_cache().iter_all():
         if pool_filter is not None and pid != pool_filter:
             continue
-        for ps in range(pool.pg_num):
-            up = [o for o in m.pg_to_up_acting_osds(pid, ps) if o >= 0]
-            total += 1
-            sizes[len(up)] = sizes.get(len(up), 0) + 1
-            for o in up:
-                counts[o] = counts.get(o, 0) + 1
+        up = [o for o in acting if o >= 0]
+        total += 1
+        sizes[len(up)] = sizes.get(len(up), 0) + 1
+        for o in up:
+            counts[o] = counts.get(o, 0) + 1
     print(f"pool pg count: {total}")
     for size, n in sorted(sizes.items()):
         print(f"size {size}\t{n}")

@@ -46,3 +46,24 @@ def test_import_does_not_flip_global_x64():
     import jax
     import ceph_tpu.crush.vectorized  # noqa: F401 -- the old offender
     assert jax.config.jax_enable_x64 is False
+
+
+def test_placement_smoke_exits_zero_with_fused_parity():
+    """bench.py --placement --smoke is the tier-1 tripwire for
+    fused/scalar placement divergence: it forces the fused path on a
+    toy map, asserts entry parity against the scalar oracle, and must
+    emit its JSON line and exit 0."""
+    import json
+    import os
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--placement", "--smoke"],
+        capture_output=True, text=True, cwd="/root/repo", env=env,
+        timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    res = json.loads(r.stdout.strip().splitlines()[-1])
+    assert res["metric"] == "placement_epoch_recompute_pgs_per_s"
+    assert res["fused_path"] is True
+    assert res["value"] > 0

@@ -1,0 +1,223 @@
+"""Placement-cache parity suite (mon/pg_mapping.py).
+
+The epoch-memoized full-cluster table must be ENTRY-IDENTICAL to the
+per-PG scalar pipeline it replaced (`OSDMap._pg_to_up_acting_scalar`)
+across randomized maps -- depths, holes, down/out OSDs, reweights,
+upmaps, pg_temp, EC + replicated pools -- plus delta-correctness
+(changed-PG set == brute-force diff) and invalidation (a stale-epoch
+read is impossible after apply_incremental)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.builder import build_hierarchy
+from ceph_tpu.mon.osdmap import (
+    OSDMap, OsdInfo, PoolSpec, Incremental, POOL_TYPE_ERASURE,
+    crush_to_dict,
+)
+from ceph_tpu.mon.pg_mapping import PGMapping, pool_pps, bulk_crush
+
+
+def make_map(seed: int, fanouts=None, pg_num: int = 16,
+             down_frac: float = 0.15, out_frac: float = 0.1) -> OSDMap:
+    """Randomized OSDMap: hierarchy depth, down/out/reweighted OSDs,
+    upmap rewrites (incl. dangling targets), pg_temp overrides (incl.
+    dead members and empty lists), one replicated + one EC pool."""
+    rnd = random.Random(seed)
+    fanouts = fanouts or rnd.choice([[6], [4, 4], [3, 3, 4], [2, 3, 2, 3]])
+    n = 1
+    for f in fanouts:
+        n *= f
+    m = OSDMap()
+    m.epoch = 1
+    m.crush = build_hierarchy(fanouts)
+    m.max_osd = n
+    for o in range(n):
+        m.osds[o] = OsdInfo(
+            up=rnd.random() >= down_frac,
+            in_cluster=rnd.random() >= out_frac,
+            weight=rnd.choice([0x10000, 0x10000, 0x8000, 0x4000]))
+    m.pools[1] = PoolSpec(pool_id=1, name="rep", size=3, pg_num=pg_num,
+                          pgp_num=pg_num)
+    m.pools[2] = PoolSpec(pool_id=2, name="ec", type=POOL_TYPE_ERASURE,
+                          size=4, min_size=3, pg_num=pg_num,
+                          pgp_num=pg_num, crush_rule=1)
+    m.pool_names = {"rep": 1, "ec": 2}
+    every = list(range(n))
+    for pid in (1, 2):
+        for _ in range(rnd.randrange(4)):
+            pg = rnd.randrange(pg_num)
+            m.pg_upmap_items[f"{pid}.{pg:x}"] = [
+                (rnd.choice(every), rnd.choice(every + [n + 3]))]
+        for _ in range(rnd.randrange(3)):
+            pg = rnd.randrange(pg_num)
+            m.pg_temp[f"{pid}.{pg:x}"] = rnd.choice([
+                [], rnd.sample(every, 3),
+                [rnd.choice(every), -1, rnd.choice(every)]])
+    return m
+
+
+def assert_table_matches_scalar(m: OSDMap, pm: PGMapping) -> None:
+    for pid, pool in m.pools.items():
+        # past pg_num too: lookups take RAW ps and must stable_mod
+        for ps in range(pool.pg_num * 2 + 3):
+            want = m._pg_to_up_acting_scalar(pid, ps)
+            got = pm.lookup(pid, ps)
+            assert got == want, (pid, ps, got, want)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_cached_table_entry_identical_to_scalar(seed):
+    m = make_map(seed)
+    assert_table_matches_scalar(m, m.placement_cache())
+
+
+def test_fused_and_scalar_builds_agree():
+    """The SAME table must come out of the fused VectorCrush launch
+    and the batched scalar sweep -- divergence here is a mapper bug
+    and must fail fast (tier-1)."""
+    m = make_map(3, fanouts=[4, 8], pg_num=64, down_frac=0.1)
+    fused = PGMapping.build(m, fused="always")
+    scalar = PGMapping.build(m, fused="never")
+    assert fused.fused_pools == len(m.pools)
+    assert scalar.scalar_pools == len(m.pools)
+    assert fused._up == scalar._up
+    assert fused._acting == scalar._acting
+    assert_table_matches_scalar(m, fused)
+
+
+def test_pool_pps_matches_scalar_hash():
+    for seed in range(4):
+        rnd = random.Random(seed)
+        pool = PoolSpec(pool_id=rnd.randrange(1, 9), name="x",
+                        pg_num=rnd.choice([8, 12, 32]),
+                        pgp_num=rnd.choice([8, 12, 32]))
+        got = pool_pps(pool)
+        want = [pool.raw_pg_to_pps(ps) for ps in range(pool.pg_num)]
+        assert list(got) == want
+
+
+def test_bulk_crush_scalar_and_fused_rows_agree():
+    m = make_map(5, fanouts=[3, 4], pg_num=32)
+    xs = np.arange(0, 500, 7)
+    w = m.osd_weights()
+    for rule in (0, 1):
+        srows, sf = bulk_crush(m.crush, rule, xs, 3, w, fused="never")
+        frows, ff = bulk_crush(m.crush, rule, xs, 3, w, fused="always")
+        assert not sf and ff
+        assert np.array_equal(srows, frows), rule
+
+
+def brute_delta(old: OSDMap, new: OSDMap) -> set:
+    """Reference diff: every (pool, pg) whose scalar (up, acting)
+    differs between the two maps, plus pools in only one of them."""
+    changed = set()
+    pools = set(old.pools) | set(new.pools)
+    for pid in pools:
+        if pid not in old.pools or pid not in new.pools:
+            src = old.pools.get(pid) or new.pools.get(pid)
+            changed |= {(pid, pg) for pg in range(src.pg_num)}
+            continue
+        span = max(old.pools[pid].pg_num, new.pools[pid].pg_num)
+        for pg in range(span):
+            if (pg >= old.pools[pid].pg_num
+                    or pg >= new.pools[pid].pg_num
+                    or old._pg_to_up_acting_scalar(pid, pg)
+                    != new._pg_to_up_acting_scalar(pid, pg)):
+                changed.add((pid, pg))
+    return changed
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_delta_matches_bruteforce_diff(seed):
+    rnd = random.Random(100 + seed)
+    m = make_map(100 + seed, pg_num=16)
+    before = OSDMap.from_dict(m.to_dict())     # independent snapshot
+    prev = m.placement_cache()
+    ups = sorted(m.osds)
+    inc = Incremental(epoch=m.epoch + 1)
+    inc.new_down = rnd.sample(ups, 2)
+    inc.new_out = [rnd.choice(ups)]
+    inc.new_weights = {rnd.choice(ups): 0x6000}
+    inc.new_pg_temp = {f"1.{rnd.randrange(16):x}": rnd.sample(ups, 3),
+                       f"2.{rnd.randrange(16):x}": []}
+    inc.new_pg_upmap_items = {
+        f"2.{rnd.randrange(16):x}": [[rnd.choice(ups),
+                                      rnd.choice(ups)]]}
+    inc.new_pools = {3: {"pool_id": 3, "name": "fresh", "pg_num": 8,
+                         "pgp_num": 8, "size": 3}}
+    m.apply_incremental(inc)
+    cur = m.placement_cache()
+    got = set(cur.delta(prev))
+    want = brute_delta(before, m)
+    assert got == want
+
+
+def test_epoch_invalidation_no_stale_reads():
+    m = make_map(42, fanouts=[4, 4], pg_num=16, down_frac=0.0)
+    gen0 = m._mutation_gen
+    up0, act0 = m.pg_to_up_acting(1, 5)
+    victim = up0[0]
+    inc = Incremental(epoch=m.epoch + 1, new_down=[victim])
+    m.apply_incremental(inc)
+    assert m._mutation_gen != gen0
+    # the very next read reflects the kill -- and stays scalar-exact
+    up1, act1 = m.pg_to_up_acting(1, 5)
+    assert victim not in up1
+    assert (up1, act1) == m._pg_to_up_acting_scalar(1, 5)
+    assert m.placement_cache().epoch == m.epoch
+    # pg_temp/upmap mutations invalidate too
+    pgid = m.pg_name(1, 5)
+    m.apply_incremental(Incremental(
+        epoch=m.epoch + 1, new_pg_temp={pgid: list(reversed(up1))}))
+    up2, act2 = m.pg_to_up_acting(1, 5)
+    assert act2 == list(reversed(up1))
+    assert (up2, act2) == m._pg_to_up_acting_scalar(1, 5)
+
+
+def test_osd_weights_memoized_per_generation():
+    m = make_map(7, fanouts=[4, 4], pg_num=8)
+    w0 = m.osd_weights()
+    assert m.osd_weights() is w0            # same generation: memo hit
+    m.apply_incremental(Incremental(epoch=m.epoch + 1,
+                                    new_weights={0: 0x2000}))
+    w1 = m.osd_weights()
+    assert w1 is not w0 and w1[0] == 0x2000
+    # out-of-band surgery path
+    m.osds[1].weight = 0x3000
+    m.invalidate_placement_cache()
+    assert m.osd_weights()[1] == 0x3000
+
+
+def test_balancer_full_mapping_rides_the_cache():
+    from ceph_tpu.mgr.balancer import full_mapping
+    m = make_map(9, pg_num=16)
+    got = full_mapping(m)
+    assert len(got) == sum(p.pg_num for p in m.pools.values())
+    for pid, pool in m.pools.items():
+        for pg in range(pool.pg_num):
+            up, _ = m._pg_to_up_acting_scalar(pid, pg)
+            assert got[f"{pid}.{pg:x}"] == up, (pid, pg)
+
+
+def test_serialized_roundtrip_keeps_parity():
+    m = make_map(13)
+    m2 = OSDMap.from_dict(m.to_dict())
+    assert_table_matches_scalar(m2, m2.placement_cache())
+    # and the two tables agree with each other
+    a, b = m.placement_cache(), m2.placement_cache()
+    assert a._up == b._up and a._acting == b._acting
+
+
+def test_lookup_counters_and_recompute_counter():
+    m = make_map(21, fanouts=[4, 4], pg_num=8)
+    m.pg_to_up_acting(1, 0)
+    m.pg_to_up_acting(1, 1)
+    d = m.placement_perf.dump()
+    assert d["bulk_recomputes"] == 1
+    assert d["lookups"] == 2
+    m.apply_incremental(Incremental(epoch=m.epoch + 1, new_down=[0]))
+    m.pg_to_up_acting(1, 0)
+    assert m.placement_perf.dump()["bulk_recomputes"] == 2

@@ -1,0 +1,37 @@
+"""Project-native static analysis.
+
+Every major bug class fixed in PRs 1-3 was a mechanically detectable
+invariant violation: raw ``CRUSH_ITEM_NONE`` leaking into ``o >= 0``
+role checks, ``jax_enable_x64`` flipped at import time, host syncs and
+recompiles inside jitted hot paths.  This package encodes those
+invariants as AST checkers so tooling -- not reviewer vigilance --
+enforces them, the way program-level checks underpin correctness in
+optimized EC pipelines.
+
+Layout:
+
+* ``core``      -- file collection, single-parse module model, inline
+                   ``# lint: disable=<rule>`` suppressions, baseline
+                   files, and the run orchestration.
+* ``registry``  -- the pluggable checker registry (``@register``).
+* ``checkers``  -- the project rules; importing the subpackage
+                   registers them.
+
+CLI front end: ``tools/lint.py`` (see README "Static analysis").
+"""
+
+from .core import (          # noqa: F401
+    Finding,
+    Module,
+    Project,
+    baseline_key,
+    collect_files,
+    filter_suppressed,
+    load_baseline,
+    run,
+    write_baseline,
+)
+from .registry import Checker, get_checkers, register   # noqa: F401
+
+# Importing the subpackage registers every built-in rule.
+from . import checkers       # noqa: F401,E402

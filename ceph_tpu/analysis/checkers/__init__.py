@@ -1,0 +1,10 @@
+"""Built-in project rules; importing this package registers them."""
+
+from . import (        # noqa: F401
+    blocking_under_lock,
+    hole_sentinel,
+    jit_stability,
+    perf_coherence,
+    tracer_safety,
+    x64_scope,
+)

@@ -1,0 +1,64 @@
+"""Pluggable checker registry.
+
+A rule is a ``Checker`` subclass registered with ``@register``; the
+runner in ``core`` parses each file once and hands the shared
+``Module`` objects to every registered checker -- ``check()`` per
+module in scope, then ``finalize()`` once with the whole project for
+cross-module passes (e.g. perf-counter coherence).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterable
+
+if TYPE_CHECKING:                         # pragma: no cover
+    from .core import Finding, Module, Project
+
+
+class Checker:
+    """Base class for one rule.
+
+    ``name`` is the rule id used in findings, ``# lint: disable=`` and
+    ``--rules``; ``description`` is one line for ``--list-rules``.
+    """
+
+    name: str = ""
+    description: str = ""
+
+    def scope(self, module: "Module") -> bool:
+        """Whether `module` is subject to this rule (default: all)."""
+        return True
+
+    def check(self, module: "Module") -> Iterable["Finding"]:
+        """Per-module pass over one parsed file."""
+        return ()
+
+    def finalize(self, project: "Project") -> Iterable["Finding"]:
+        """Cross-module pass, called once after every check()."""
+        return ()
+
+
+_REGISTRY: dict[str, Checker] = {}
+
+
+def register(cls: type[Checker]) -> type[Checker]:
+    """Class decorator: instantiate and register a checker by name."""
+    if not cls.name:
+        raise ValueError(f"checker {cls.__name__} has no name")
+    if cls.name in _REGISTRY:
+        raise ValueError(f"duplicate checker name {cls.name!r}")
+    _REGISTRY[cls.name] = cls()
+    return cls
+
+
+def get_checkers(names: Iterable[str] | None = None) -> list[Checker]:
+    """All registered checkers, or the named subset (order stable)."""
+    if names is None:
+        return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+    out = []
+    for n in names:
+        if n not in _REGISTRY:
+            known = ", ".join(sorted(_REGISTRY))
+            raise KeyError(f"unknown rule {n!r} (known: {known})")
+        out.append(_REGISTRY[n])
+    return out

@@ -13,6 +13,15 @@ rotations and a stolen ticket dies at its expiry.
 AES-GCM does the sealing (the reference uses AES-CBC+hmac; GCM is the
 modern equivalent of seal-with-integrity).  Entity keys are the hex
 strings the mon's AuthMonitor db already stores.
+
+`cryptography` is an OPTIONAL dependency: when the wheel is absent
+(minimal CI images, the TPU pod base image), sealing falls back to a
+stdlib AEAD -- a SHA-256 counter-mode keystream with an encrypt-then-
+HMAC tag.  Same API, same blob framing (nonce || ciphertext+tag), so
+the protocol shape and every failure mode (tamper, wrong key, expiry)
+stay testable without the wheel.  It is NOT AES-GCM and makes no
+side-channel claims; production deployments install `cryptography`
+(`have_aesgcm()` says which path is live).
 """
 
 from __future__ import annotations
@@ -23,10 +32,64 @@ import json
 import os
 import time
 
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:                    # pragma: no cover - env detail
+    AESGCM = None
+
+
+def have_aesgcm() -> bool:
+    """True when the real AES-GCM backend (`cryptography`) is live."""
+    return AESGCM is not None
+
+
+class _StreamAEAD:
+    """Stdlib fallback with the AESGCM call shape: encrypt-then-MAC
+    over a SHA-256 keystream.  Tag covers nonce, AAD, and ciphertext;
+    constant-time compare on open."""
+
+    _TAG = 16
+
+    def __init__(self, key: bytes) -> None:
+        self._key = key
+
+    def _keystream(self, nonce: bytes, n: int) -> bytes:
+        out = bytearray()
+        ctr = 0
+        while len(out) < n:
+            out += hashlib.sha256(
+                self._key + nonce + ctr.to_bytes(8, "big")).digest()
+            ctr += 1
+        return bytes(out[:n])
+
+    def _tag(self, nonce: bytes, ct: bytes, aad: bytes) -> bytes:
+        return hmac.new(self._key, nonce + aad + ct,
+                        hashlib.sha256).digest()[:self._TAG]
+
+    def encrypt(self, nonce: bytes, data: bytes,
+                aad: bytes | None) -> bytes:
+        aad = aad or b""
+        ct = bytes(a ^ b for a, b in
+                   zip(data, self._keystream(nonce, len(data))))
+        return ct + self._tag(nonce, ct, aad)
+
+    def decrypt(self, nonce: bytes, blob: bytes,
+                aad: bytes | None) -> bytes:
+        aad = aad or b""
+        if len(blob) < self._TAG:
+            raise ValueError("sealed blob truncated")
+        ct, tag = blob[:-self._TAG], blob[-self._TAG:]
+        if not hmac.compare_digest(self._tag(nonce, ct, aad), tag):
+            raise ValueError("seal authentication failed")
+        return bytes(a ^ b for a, b in
+                     zip(ct, self._keystream(nonce, len(ct))))
+
 
 def _aes(key_material: bytes):
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-    return AESGCM(hashlib.sha256(key_material).digest())
+    key = hashlib.sha256(key_material).digest()
+    if AESGCM is not None:
+        return AESGCM(key)
+    return _StreamAEAD(key)
 
 
 def seal(key_material: bytes, obj: dict) -> str:

@@ -356,15 +356,19 @@ class Messenger:
         nonce + CLIENT nonce + salt (a replayed server hello cannot
         force key reuse -- the client's nonce is fresh), with a
         direction label (c2s/s2c) so the two streams never share a key
-        (cephx-style session key into AES-GCM, crypto_onwire.cc)."""
-        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        (cephx-style session key into AES-GCM, crypto_onwire.cc).
+        The AEAD comes from cephx._aes: real AES-GCM when the
+        optional `cryptography` wheel is present, the stdlib fallback
+        otherwise (both ends of a connection share the environment in
+        tests, so the negotiated mode always matches)."""
+        from ..common.cephx import _aes
         secret = secret if secret is not None else self.secret
         base = nonce + cnonce + salt
 
         def key(label: bytes):
-            return AESGCM(hmac.new(secret,
-                                   b"ctv2-secure-" + label + base,
-                                   hashlib.sha256).digest())
+            return _aes(hmac.new(secret,
+                                 b"ctv2-secure-" + label + base,
+                                 hashlib.sha256).digest())
         return key(b"c2s"), key(b"s2c")
 
     def _nego_mac(self, nego: dict, nonce: bytes,

@@ -1,0 +1,21 @@
+"""BAD: raw mapper output role-checked without a sentinel guard."""
+
+from ceph_tpu.crush.mapper import crush_do_rule
+
+
+def primary_of(crush, rule, pps, size, weights):
+    raw = crush_do_rule(crush, rule, pps, size, weights)
+    for o in raw:
+        if o >= 0:                  # hole-sentinel: NONE passes this
+            return o
+    return None
+
+
+def count_live(raw):
+    return sum(1 for osd in raw if osd != -1)
+
+
+def has_primary(osd):
+    if osd:                         # truthiness: osd.0 and NONE lie
+        return True
+    return False

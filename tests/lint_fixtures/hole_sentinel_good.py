@@ -1,0 +1,22 @@
+"""GOOD: same raw access, but the functions handle the sentinel."""
+
+from ceph_tpu.crush.mapper import crush_do_rule
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+
+
+def primary_of(crush, rule, pps, size, weights):
+    raw = crush_do_rule(crush, rule, pps, size, weights)
+    for o in raw:
+        if o != CRUSH_ITEM_NONE and o >= 0:
+            return o
+    return None
+
+
+def normalize(raw):
+    return [o if o != CRUSH_ITEM_NONE else -1 for o in raw]
+
+
+def count_live(raw):
+    # plural names are id collections, not ids: not flagged
+    osds = [o for o in normalize(raw) if o != CRUSH_ITEM_NONE]
+    return len(osds) if osds else 0

@@ -1,0 +1,18 @@
+"""BAD: recompile hazards -- jit in a loop, traced self."""
+
+import jax
+import jax.numpy as jnp
+
+
+def encode_all(stripes):
+    outs = []
+    for s in stripes:
+        fn = jax.jit(lambda x: x * 2)   # fresh callable per stripe
+        outs.append(fn(s))
+    return outs
+
+
+class Mapper:
+    @jax.jit                            # self is traced: unhashable
+    def map_one(self, xs):
+        return jnp.sum(xs)

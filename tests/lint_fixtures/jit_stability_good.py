@@ -1,0 +1,18 @@
+"""GOOD: jitted callables built once, self marked static."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_double = jax.jit(lambda x: x * 2)      # module-level: built once
+
+
+def encode_all(stripes):
+    return [_double(s) for s in stripes]
+
+
+class Mapper:
+    @partial(jax.jit, static_argnames=("self",))
+    def map_one(self, xs):
+        return jnp.sum(xs)

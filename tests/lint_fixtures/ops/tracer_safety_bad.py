@@ -1,0 +1,26 @@
+"""BAD: host syncs and Python branching inside traced code."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=())
+def normalize(x, scale):
+    if scale > 0:                       # if-on-tracer
+        x = x / scale
+    host = np.asarray(x)                # device->host sync per call
+    peak = x.max().item()               # ditto
+    return jnp.asarray(host) * float(peak)
+
+
+def make_kernel(k):
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * int(x_ref[0, 0])   # concretizes
+    return kernel
+
+
+def build(k, pallas_call):
+    return pallas_call(make_kernel(k))

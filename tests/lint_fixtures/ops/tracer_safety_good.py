@@ -1,0 +1,22 @@
+"""GOOD: static args branch in Python, host syncs stay outside."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def scale(x, mode):
+    if mode == "double":                # static arg: Python-level
+        return x * 2
+    return x
+
+
+def run(xs, mode):
+    xs = jnp.asarray(xs)                # outside the jitted scope
+    out = scale(xs, mode)
+    if out is None:                     # optionality, not tracer flow
+        return None
+    return np.asarray(out)              # sync after the launch

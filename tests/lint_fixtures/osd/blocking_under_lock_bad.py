@@ -1,0 +1,15 @@
+"""BAD: synchronous stalls while holding locks."""
+
+import time
+
+
+class Flusher:
+    def flush(self, sock):
+        with self._lock:
+            time.sleep(0.5)             # every waiter eats this
+            data = sock.recv(4096)      # network latency under lock
+        return data
+
+    async def drain(self, fut):
+        async with self.lock:
+            return fut.result()         # blocks the loop thread

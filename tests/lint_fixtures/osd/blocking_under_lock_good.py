@@ -1,0 +1,21 @@
+"""GOOD: the work happens outside the lock region."""
+
+import time
+
+
+class Flusher:
+    def flush(self, sock):
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+
+            def retry():                # runs later, not under lock
+                time.sleep(0.5)
+        data = sock.recv(4096)          # I/O after release
+        time.sleep(0.01)
+        return pending, data, retry
+
+    async def drain(self, fut):
+        async with self.lock:
+            self._draining = True
+        return await fut                # awaited outside the region

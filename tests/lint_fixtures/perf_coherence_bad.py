@@ -1,0 +1,11 @@
+"""BAD: three perf-counter shape mismatches (cross-module pass)."""
+
+
+def record_batch(perf, total, dt):
+    perf.hist_sample("fx_stripes_hist", total)   # never registered
+    perf.inc("fx_mixed_key")
+    perf.tinc("fx_mixed_key", dt)                # kind collision
+
+
+def setup(perf):
+    perf.hist_register("fx_dead_hist", [1.0, 8.0, 64.0])  # never fed

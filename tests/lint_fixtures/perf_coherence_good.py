@@ -1,0 +1,16 @@
+"""GOOD: every key keeps one kind; histograms register + sample."""
+
+
+def setup(perf):
+    perf.hist_register("fx_live_hist", [1.0, 8.0, 64.0])
+
+
+def record_batch(perf, total, dt):
+    perf.hist_sample("fx_live_hist", total)
+    perf.inc("fx_batches")
+    perf.tinc("fx_batch_seconds", dt)
+    perf.set_gauge("fx_depth", total)
+
+
+def dynamic(perf, key):
+    perf.inc(key)          # non-literal keys are out of scope

@@ -1,0 +1,14 @@
+"""BAD: process-global x64 flips (the PR 1 import-time hazard)."""
+
+import jax
+from jax import config
+
+jax.config.update("jax_enable_x64", True)       # at import time!
+
+
+def enable_wide_hashes():
+    config.update("jax_enable_x64", True)
+
+
+def backdoor():
+    jax.config.jax_enable_x64 = True

@@ -1,0 +1,15 @@
+"""GOOD: x64 stays scoped to the sanctioned context manager."""
+
+import jax.numpy as jnp
+from jax.experimental import enable_x64
+
+
+def hash64(xs):
+    with enable_x64():
+        return jnp.asarray(xs, jnp.int64) * jnp.int64(2654435761)
+
+
+def unrelated_update(d):
+    # dict.update with a same-named key string is not a config flip
+    d.update({"jax_enable_x64": "documentation only"})
+    return d

@@ -347,3 +347,40 @@ def test_ticket_client_falls_back_to_psk_server():
         await client.shutdown()
         await server.shutdown()
     run(main())
+
+
+# -- unit: optional-dependency fallback AEAD ---------------------------------
+# `cryptography` is optional (common/cephx.py): these pin the stdlib
+# _StreamAEAD explicitly so the fallback path stays covered even in
+# environments where the real AES-GCM wheel IS installed.
+
+def test_fallback_aead_roundtrip_tamper_and_wrong_key():
+    from ceph_tpu.common.cephx import _StreamAEAD
+    a = _StreamAEAD(b"k" * 32)
+    nonce = b"n" * 12
+    blob = a.encrypt(nonce, b"payload bytes", b"aad")
+    assert a.decrypt(nonce, blob, b"aad") == b"payload bytes"
+    # bit-flip in ciphertext, truncation, wrong AAD, wrong key: all
+    # must fail closed
+    flipped = bytes([blob[0] ^ 1]) + blob[1:]
+    with pytest.raises(ValueError):
+        a.decrypt(nonce, flipped, b"aad")
+    with pytest.raises(ValueError):
+        a.decrypt(nonce, blob[:8], b"aad")
+    with pytest.raises(ValueError):
+        a.decrypt(nonce, blob, b"other-aad")
+    with pytest.raises(ValueError):
+        _StreamAEAD(b"x" * 32).decrypt(nonce, blob, b"aad")
+
+
+def test_seal_unseal_work_without_cryptography_wheel():
+    """seal/unseal (and thus tickets, rotating keys, secure mode)
+    must function on the active backend, wheel or fallback."""
+    from ceph_tpu.common.cephx import have_aesgcm
+    obj = {"session_key": "ab" * 16, "expires": 123.0}
+    key = b"\x01" * 24
+    blob = seal(key, obj)
+    assert unseal(key, blob) == obj
+    with pytest.raises(Exception):
+        unseal(b"\x02" * 24, blob)
+    assert have_aesgcm() in (True, False)   # importable either way

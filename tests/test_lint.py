@@ -1,0 +1,193 @@
+"""Tier-1 gate for the project static analyzer (ceph_tpu.analysis).
+
+Three contracts:
+
+* the shipped tree is clean: `python tools/lint.py` (ceph_tpu, tools,
+  bench.py) produces zero unsuppressed, unbaselined findings;
+* every rule fires on its bad fixture and stays silent on its good
+  fixture (tests/lint_fixtures/);
+* the suppression layers round-trip: inline `# lint: disable=` and
+  the baseline file each absorb exactly the findings they name.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu import analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+TREE_PATHS = ["ceph_tpu", "tools", "bench.py"]
+BASELINE = os.path.join(REPO, "tools", "lint_baseline.txt")
+
+RULE_FIXTURES = {
+    "hole-sentinel": ("hole_sentinel_bad.py",
+                      "hole_sentinel_good.py"),
+    "x64-scope": ("x64_scope_bad.py", "x64_scope_good.py"),
+    "tracer-safety": ("ops/tracer_safety_bad.py",
+                      "ops/tracer_safety_good.py"),
+    "jit-stability": ("jit_stability_bad.py",
+                      "jit_stability_good.py"),
+    "perf-coherence": ("perf_coherence_bad.py",
+                       "perf_coherence_good.py"),
+    "blocking-under-lock": ("osd/blocking_under_lock_bad.py",
+                            "osd/blocking_under_lock_good.py"),
+}
+
+
+def lint(paths, root, rules=None, baseline=None):
+    findings, project = analysis.run(paths, root=root, rules=rules)
+    kept, n_inline, n_base = analysis.filter_suppressed(
+        findings, project, baseline or set())
+    return kept, n_inline, n_base
+
+
+# -- the acceptance gate ----------------------------------------------------
+
+def test_tree_is_clean():
+    baseline = analysis.load_baseline(BASELINE)
+    kept, _, _ = lint(TREE_PATHS, REPO, baseline=baseline)
+    assert kept == [], "\n".join(f.render() for f in kept)
+
+
+def test_all_rules_registered():
+    names = {c.name for c in analysis.get_checkers()}
+    assert set(RULE_FIXTURES) <= names
+
+
+# -- per-rule fixture corpus ------------------------------------------------
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_fires_on_bad_fixture(rule):
+    bad, _ = RULE_FIXTURES[rule]
+    kept, _, _ = lint([bad], FIXTURES, rules=[rule])
+    assert kept, f"{rule} found nothing in {bad}"
+    assert all(f.rule == rule for f in kept)
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_silent_on_good_fixture(rule):
+    _, good = RULE_FIXTURES[rule]
+    kept, _, _ = lint([good], FIXTURES, rules=[rule])
+    assert kept == [], "\n".join(f.render() for f in kept)
+
+
+def test_bad_fixtures_do_not_cross_fire():
+    """Each bad fixture trips only its own rule (rule independence)."""
+    for rule, (bad, _) in RULE_FIXTURES.items():
+        kept, _, _ = lint([bad], FIXTURES)
+        assert kept and {f.rule for f in kept} == {rule}, (
+            rule, [f.render() for f in kept])
+
+
+# -- suppression round-trips ------------------------------------------------
+
+BAD_SNIPPET = 'import jax\njax.config.update("jax_enable_x64", True)\n'
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_inline_suppression_same_line(tmp_path):
+    _write(tmp_path, "mod.py", BAD_SNIPPET.replace(
+        "True)", "True)  # lint: disable=x64-scope"))
+    kept, n_inline, _ = lint(["mod.py"], str(tmp_path))
+    assert kept == [] and n_inline == 1
+
+
+def test_inline_suppression_standalone_line_above(tmp_path):
+    _write(tmp_path, "mod.py",
+           "import jax\n# lint: disable=x64-scope\n"
+           'jax.config.update("jax_enable_x64", True)\n')
+    kept, n_inline, _ = lint(["mod.py"], str(tmp_path))
+    assert kept == [] and n_inline == 1
+
+
+def test_inline_suppression_wrong_rule_does_not_apply(tmp_path):
+    _write(tmp_path, "mod.py", BAD_SNIPPET.replace(
+        "True)", "True)  # lint: disable=hole-sentinel"))
+    kept, n_inline, _ = lint(["mod.py"], str(tmp_path))
+    assert len(kept) == 1 and n_inline == 0
+
+
+def test_inline_suppression_bare_disable_suppresses_all(tmp_path):
+    _write(tmp_path, "mod.py", BAD_SNIPPET.replace(
+        "True)", "True)  # lint: disable"))
+    kept, n_inline, _ = lint(["mod.py"], str(tmp_path))
+    assert kept == [] and n_inline == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    _write(tmp_path, "mod.py", BAD_SNIPPET)
+    kept, _, _ = lint(["mod.py"], str(tmp_path))
+    assert len(kept) == 1
+    bl_path = str(tmp_path / "baseline.txt")
+    analysis.write_baseline(bl_path, kept)
+    baseline = analysis.load_baseline(bl_path)
+    kept2, _, n_base = lint(["mod.py"], str(tmp_path),
+                            baseline=baseline)
+    assert kept2 == [] and n_base == 1
+    # baseline keys are line-number free: an unrelated edit above the
+    # finding must not resurrect it
+    _write(tmp_path, "mod.py", "import os  # noqa\n" + BAD_SNIPPET)
+    kept3, _, n_base3 = lint(["mod.py"], str(tmp_path),
+                             baseline=baseline)
+    assert kept3 == [] and n_base3 == 1
+
+
+def test_syntax_error_is_a_parse_finding(tmp_path):
+    _write(tmp_path, "mod.py", "def broken(:\n")
+    kept, _, _ = lint(["mod.py"], str(tmp_path))
+    assert len(kept) == 1 and kept[0].rule == "parse"
+
+
+def test_unknown_rule_raises():
+    with pytest.raises(KeyError):
+        analysis.run(["hole_sentinel_bad.py"], root=FIXTURES,
+                     rules=["no-such-rule"])
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _cli(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         *argv],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_full_tree_exits_zero():
+    res = _cli()
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.strip() == ""
+
+
+def test_cli_list_rules_names_all_six():
+    res = _cli("--list-rules")
+    assert res.returncode == 0
+    for rule in RULE_FIXTURES:
+        assert rule in res.stdout
+
+
+def test_cli_nonzero_on_findings_and_rule_filter():
+    bad = os.path.join("tests", "lint_fixtures",
+                       "x64_scope_bad.py")
+    res = _cli("--rules", "x64-scope", bad)
+    assert res.returncode == 1
+    assert "x64-scope" in res.stdout
+    res2 = _cli("--rules", "hole-sentinel", bad)
+    assert res2.returncode == 0
+
+
+def test_cli_changed_mode_runs():
+    """--changed lints only git-dirty files inside the default scope
+    (never the fixture corpus), so it exits clean on a clean tree and
+    on a tree whose dirty files pass the rules."""
+    res = _cli("--changed")
+    assert res.returncode == 0, res.stdout + res.stderr

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Project-native static analysis CLI (front end for
+``ceph_tpu.analysis``).
+
+    python tools/lint.py                      # lint the default tree
+    python tools/lint.py ceph_tpu/osd         # lint a subtree
+    python tools/lint.py --changed            # only git-dirty files
+    python tools/lint.py --list-rules
+    python tools/lint.py --rules hole-sentinel,x64-scope ceph_tpu
+    python tools/lint.py --write-baseline     # accept current findings
+
+Findings print as ``path:line rule message``; exit status is non-zero
+when any unsuppressed, unbaselined finding remains.  Suppress a single
+site with a trailing ``# lint: disable=<rule>`` comment; park legacy
+findings in ``tools/lint_baseline.txt`` (kept empty -- the tree is
+clean -- but the mechanism is how a new rule lands without blocking).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from ceph_tpu import analysis                            # noqa: E402
+
+DEFAULT_PATHS = ["ceph_tpu", "tools", "bench.py"]
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools",
+                                "lint_baseline.txt")
+
+
+def _in_default_scope(path: str) -> bool:
+    """--changed only lints dirty files the full run would cover
+    (never e.g. the bad-on-purpose fixture corpus under tests/)."""
+    for scope in DEFAULT_PATHS:
+        if path == scope or path.startswith(scope + "/"):
+            return True
+    return False
+
+
+def changed_files(root: str) -> list[str]:
+    """Python files touched per git (worktree + index + untracked),
+    restricted to the default lint scope."""
+    out = subprocess.run(
+        ["git", "status", "--porcelain"], cwd=root,
+        capture_output=True, text=True, check=True).stdout
+    files = []
+    for line in out.splitlines():
+        if len(line) < 4 or line[0] == "D" or line[1] == "D":
+            continue
+        path = line[3:].split(" -> ")[-1].strip().strip('"')
+        if (path.endswith(".py") and _in_default_scope(path)
+                and os.path.exists(os.path.join(root, path))):
+            files.append(path)
+    return sorted(set(files))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint.py",
+        description="ceph_tpu project static analysis")
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: "
+                         f"{' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files git considers modified "
+                         "(fast pre-commit mode)")
+    ap.add_argument("--rules",
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print registered rules and exit")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: "
+                         "tools/lint_baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline with the current "
+                         "unsuppressed findings and exit 0")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for checker in analysis.get_checkers():
+            print(f"{checker.name:22s} {checker.description}")
+        return 0
+
+    rules = (args.rules.split(",") if args.rules else None)
+    if args.changed:
+        paths = changed_files(REPO_ROOT)
+        if not paths:
+            print("lint: no changed python files", file=sys.stderr)
+            return 0
+    else:
+        paths = args.paths or DEFAULT_PATHS
+
+    try:
+        findings, project = analysis.run(paths, root=REPO_ROOT,
+                                         rules=rules)
+    except KeyError as e:                   # unknown --rules entry
+        print(f"lint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    baseline = (set() if args.no_baseline or args.write_baseline
+                else analysis.load_baseline(args.baseline))
+    kept, n_inline, n_base = analysis.filter_suppressed(
+        findings, project, baseline)
+
+    if args.write_baseline:
+        analysis.write_baseline(args.baseline, kept)
+        print(f"lint: wrote {len(kept)} finding(s) to "
+              f"{os.path.relpath(args.baseline, REPO_ROOT)}",
+              file=sys.stderr)
+        return 0
+
+    for f in kept:
+        print(f.render())
+    nfiles = len(project.modules)
+    extras = []
+    if n_inline:
+        extras.append(f"{n_inline} inline-suppressed")
+    if n_base:
+        extras.append(f"{n_base} baselined")
+    extra = f" ({', '.join(extras)})" if extras else ""
+    print(f"lint: {len(kept)} finding(s) across {nfiles} "
+          f"file(s){extra}", file=sys.stderr)
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""dropped-task: fire-and-forget asyncio tasks must not die silently.
+
+A bare ``asyncio.create_task(...)`` / ``ensure_future(...)`` statement
+drops the Task object on the floor: its exception is never retrieved
+(the failure surfaces, at best, as a "Task exception was never
+retrieved" stderr line long after the fact) and CPython keeps only a
+weak reference to running tasks, so the garbage collector may cancel
+it mid-flight.  Every daemon loop here learned this the hard way --
+the OSD/mgr/monitor all route spawns through ``make_task_tracker`` or
+keep the handle on ``self``.
+
+Compliant forms: assign the result (to a name, attribute, or through
+a tracker like ``self._track(...)``), await it, or chain an immediate
+``.add_done_callback(...)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .. import astutil
+from ..core import Finding, Module
+from ..registry import Checker, register
+
+_SPAWNERS = {"create_task", "ensure_future"}
+
+
+@register
+class DroppedTask(Checker):
+    name = "dropped-task"
+    description = ("asyncio create_task/ensure_future result dropped "
+                   "without a done-callback (silent task death)")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            leaf = astutil.name_leaf(node.value.func)
+            if leaf in _SPAWNERS:
+                yield Finding(
+                    module.path, node.lineno, self.name,
+                    f"{leaf}() result dropped: the task's exception is "
+                    f"never retrieved and the GC may cancel it "
+                    f"mid-flight; keep a reference (tracker/attribute) "
+                    f"or attach a done-callback")

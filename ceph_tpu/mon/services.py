@@ -22,10 +22,23 @@ and replays with the same quorum guarantees as the map itself:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 
 LOG_CAP = 1000
+
+
+def _surface_task_death(task) -> None:
+    """Done-callback for fire-and-forget publish tasks: a task whose
+    exception is never retrieved dies silently (and may be GC'd
+    mid-flight) -- retrieve it and log instead."""
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        logging.getLogger("ceph_tpu.mon").warning(
+            "mgr_map publish task died: %r", exc)
 
 
 class UnknownCommand(Exception):
@@ -94,7 +107,8 @@ class MonServices:
             # (daemons may be sessioned to a peon)
             import asyncio as _asyncio
             try:
-                _asyncio.ensure_future(self.mon._publish_mgr_map())
+                t = _asyncio.ensure_future(self.mon._publish_mgr_map())
+                t.add_done_callback(_surface_task_death)
             except RuntimeError:
                 pass          # replay outside a loop (mon boot)
         for key, val in service_kv.get("kvstore", {}).items():

@@ -1,8 +1,19 @@
-"""TPU compute kernels (JAX/XLA/Pallas) for the erasure-code hot path."""
+"""TPU compute kernels (JAX/XLA/Pallas) for the erasure-code hot path.
 
-from .gf2kernels import (  # noqa: F401
-    gf_matmul_device,
-    gf_matmul_batch_device,
-    bitmatrix_i8,
-    clear_kernel_cache,
-)
+Re-exports resolve lazily (PEP 562): ``crc32c_batch`` is a numpy-only
+module consumed by jax-free paths (native fallback, blockstore, scrub),
+so importing the package must not pay the jax stack -- only touching a
+GF kernel name pulls ``gf2kernels``.
+"""
+
+_GF_EXPORTS = ("gf_matmul_device", "gf_matmul_batch_device",
+               "bitmatrix_i8", "clear_kernel_cache")
+
+__all__ = list(_GF_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _GF_EXPORTS:
+        from . import gf2kernels
+        return getattr(gf2kernels, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

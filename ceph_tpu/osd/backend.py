@@ -31,7 +31,7 @@ SHARD_XATTR = "_shard"  # WRITE-TIME-PINNED shard id of the stored bytes
                         # and recovery verify this label instead of
                         # trusting the OSD's CURRENT acting-set position,
                         # which changes across re-peering
-CRC_XATTR = "_crc"      # crc32 of the stored shard bytes (the per-shard
+CRC_XATTR = "_crc"      # CRC32C of the stored shard bytes (the per-shard
                         # hashinfo digest): rejects payloads/replies whose
                         # bytes don't match their claimed identity
 HIDDEN_XATTRS = frozenset({SIZE_XATTR, VER_XATTR, SHARD_XATTR,
@@ -39,8 +39,37 @@ HIDDEN_XATTRS = frozenset({SIZE_XATTR, VER_XATTR, SHARD_XATTR,
 
 
 def shard_crc(data) -> int:
+    """CRC32C of shard bytes -- ONE polynomial everywhere (the same
+    kernel the codec batcher, scrub and blockstore ride).  Pre-
+    unification tags were zlib.crc32 (a different polynomial);
+    shard_crc_matches() keeps those readable."""
+    from ..ops.crc32c_batch import crc32c_batch
+    return int(crc32c_batch([bytes(data)])[0])
+
+
+def shard_crc_matches(data, tag, precomputed: int | None = None) -> bool:
+    """Does a stored/reported ``_crc`` tag vouch for ``data``?
+
+    Matches the unified CRC32C first (``precomputed`` lets batched
+    verify paths pass a value they already hold).  On mismatch, ONE
+    compat re-check against the pre-unification zlib.crc32 polynomial
+    accepts tags stamped before the integrity pipeline unified -- a
+    genuinely corrupt buffer pays the second hash only on the failure
+    path, and the legacy acceptance is counted so it can be watched
+    going to zero.
+    """
+    if tag is None:
+        return True
+    tag = int(tag)
+    crc = shard_crc(data) if precomputed is None else int(precomputed)
+    if crc == tag:
+        return True
     import zlib
-    return zlib.crc32(bytes(data)) & 0xFFFFFFFF
+    if zlib.crc32(bytes(data)) & 0xFFFFFFFF == tag:
+        from ..ops.crc32c_batch import PERF
+        PERF.inc("legacy_crc_tags")
+        return True
+    return False
 
 
 def ver_encode(version) -> bytes:
@@ -451,34 +480,17 @@ class ECBackend(PGBackend):
         out: dict[int, tuple] = {}
         failed: set[int] = set()
         relabeled: dict[int, tuple] = {}
-
-        def classify(s: int, label, crc, buf, size, ver) -> None:
-            if not self._label_ok(s, label, buf, ver):
-                self._count("shard_mismatch")
-                failed.add(s)
-                # CRC-verified bytes under their OWN label are salvage,
-                # not garbage (ranged reads can't re-check the whole-
-                # shard crc; the label xattr alone vouches there)
-                if label is not None and int(label) >= 0 and \
-                        (rng is not None or crc is None
-                         or shard_crc(buf) == int(crc)):
-                    relabeled.setdefault(int(label), (buf, size, ver))
-                return
-            if rng is None and crc is not None \
-                    and shard_crc(buf) != int(crc):
-                self._count("crc_mismatch")
-                failed.add(s)
-                return
-            out[s] = (buf, size, ver)
+        entries: list[tuple] = []        # (shard, label, crc, buf, size, ver)
 
         remote = []
         for s in shards:
             if avail[s] == self.osd.whoami:
                 buf, size, ver = self._local_shard(oid, rng)
                 crc_raw = self.store.getattr(self.coll, oid, CRC_XATTR)
-                classify(s, self.shard_label(oid),
-                         int(crc_raw) if crc_raw is not None else None,
-                         buf, size, ver)
+                entries.append(
+                    (s, self.shard_label(oid),
+                     int(crc_raw) if crc_raw is not None else None,
+                     buf, size, ver))
             else:
                 remote.append(s)
         if remote:
@@ -496,12 +508,41 @@ class ECBackend(PGBackend):
                     continue
                 buf = np.frombuffer(
                     rep.segments[0] if rep.segments else b"", np.uint8)
-                classify(s, rep.data.get("shard"),
-                         rep.data.get("crc"), buf,
-                         rep.data.get("size", 0),
-                         tuple(rep.data.get("ver", (0, 0))))
-            failed |= {s for s in remote
-                       if s not in out and s not in failed}
+                entries.append(
+                    (s, rep.data.get("shard"), rep.data.get("crc"),
+                     buf, rep.data.get("size", 0),
+                     tuple(rep.data.get("ver", (0, 0)))))
+        # whole-shard fetches verify their CRC tags in ONE batched pass
+        # over every gathered buffer (the hot read path used to re-hash
+        # each reply with its own scalar host call)
+        crcs = None
+        if rng is None and entries:
+            from ..ops.crc32c_batch import crc32c_batch
+            crcs = crc32c_batch([e[3] for e in entries])
+
+        for i, (s, label, crc, buf, size, ver) in enumerate(entries):
+            have = None if crcs is None else int(crcs[i])
+            if not self._label_ok(s, label, buf, ver):
+                self._count("shard_mismatch")
+                failed.add(s)
+                # CRC-verified bytes under their OWN label are salvage,
+                # not garbage (ranged reads can't re-check the whole-
+                # shard crc; the label xattr alone vouches there)
+                if label is not None and int(label) >= 0 and \
+                        (rng is not None or crc is None
+                         or shard_crc_matches(buf, crc,
+                                              precomputed=have)):
+                    relabeled.setdefault(int(label), (buf, size, ver))
+                continue
+            if rng is None and crc is not None \
+                    and not shard_crc_matches(buf, crc,
+                                              precomputed=have):
+                self._count("crc_mismatch")
+                failed.add(s)
+                continue
+            out[s] = (buf, size, ver)
+        failed |= {s for s in remote
+                   if s not in out and s not in failed}
         return out, failed, relabeled
 
     async def _gather_shards(self, oid: str,
@@ -670,11 +711,18 @@ class ECBackend(PGBackend):
             padded = bytes(logical) + b"\0" * (
                 self.sinfo.logical_to_next_stripe_offset(size) - size)
             if padded:
-                shards = await self.sinfo.encode_async(
-                    self.codec, padded, batcher=self.batcher)
+                # the codec launch returns the shard CRCs along with
+                # the parity: the identity stamp below consumes them
+                # instead of re-hashing bytes the encoder just produced
+                shards, shard_crcs = await self.sinfo.encode_async(
+                    self.codec, padded, batcher=self.batcher,
+                    with_crc=True)
             else:
                 shards = {i: np.zeros(0, np.uint8)
                           for i in range(len(acting))}
+                empty_crc = shard_crc(b"")
+                shard_crcs = {i: empty_crc
+                              for i in range(len(acting))}
             sw = self.sinfo.stripe_width
             self.cache.truncate_beyond(entry.oid, len(padded) // sw)
             if len(padded) <= self.cache.max_bytes // 4:
@@ -689,7 +737,8 @@ class ECBackend(PGBackend):
             for shard in range(len(acting)):
                 buf = shards[shard].tobytes()
                 per_shard.append({"size": size, "shard_len": len(buf),
-                                  "attrs": None})
+                                  "attrs": None,
+                                  "crc": int(shard_crcs[shard])})
                 segs_per_shard.append([buf])
         # local shard applies in-line; remote shards via ec_subop_write
         awaiting = []
@@ -934,23 +983,28 @@ class ECBackend(PGBackend):
         self.pg.append_log_and_meta(txn, entry)
         self.store.queue_transaction(txn)
         if not w.get("remove"):
-            self._stamp_identity(oid, shard)
+            self._stamp_identity(oid, shard, crc=w.get("crc"))
 
-    def _stamp_identity(self, oid: str, shard: int | None) -> None:
+    def _stamp_identity(self, oid: str, shard: int | None,
+                        crc: int | None = None) -> None:
         """Post-commit identity tag: shard label + CRC of the FINAL
-        shard content (ranged RMW writes touch slices, so the digest is
-        taken from the store after the txn applied -- queue_transaction
-        is synchronous, no interleaving await)."""
-        try:
-            cur = self.store.read(self.coll, oid, 0, None)
-        except FileNotFoundError:
-            return
+        shard content.  Full-shard writes pass the ``crc`` the codec
+        launch already computed (no read-back, no re-hash); ranged RMW
+        writes touch slices, so their digest is taken from the store
+        after the txn applied (queue_transaction is synchronous, no
+        interleaving await) -- still through the batched kernel."""
+        if crc is None:
+            try:
+                cur = self.store.read(self.coll, oid, 0, None)
+            except FileNotFoundError:
+                return
+            crc = shard_crc(cur)
         txn = Transaction()
         if shard is not None:
             txn.setattr(self.coll, oid, SHARD_XATTR,
                         str(int(shard)).encode())
         txn.setattr(self.coll, oid, CRC_XATTR,
-                    str(shard_crc(cur)).encode())
+                    str(int(crc)).encode())
         self.store.queue_transaction(txn)
 
     # -- read path ----------------------------------------------------------

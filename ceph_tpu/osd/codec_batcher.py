@@ -72,7 +72,7 @@ class _Group:
         self.codec = codec
         self.kind = kind                 # "encode" | "decode"
         self.extra = extra               # decode: erasure tuple
-        self.items: list[tuple[np.ndarray, asyncio.Future]] = []
+        self.items: list[tuple[np.ndarray, asyncio.Future, bool]] = []
         self.n_stripes = 0
         self.task: asyncio.Task | None = None
 
@@ -112,9 +112,20 @@ class CodecBatcher:
                 and not codec.get_chunk_mapping())
 
     # -- submission ---------------------------------------------------------
-    async def encode(self, codec, stripes: np.ndarray) -> np.ndarray:
-        """(n, k, L) data chunks -> (n, m, L) parity chunks."""
-        return await self._submit("encode", codec, stripes, ())
+    async def encode(self, codec, stripes: np.ndarray,
+                     with_crc: bool = False):
+        """(n, k, L) data chunks -> (n, m, L) parity chunks.
+
+        With ``with_crc`` the result is ``(parity, crcs)`` where crcs
+        is (n, k+m) uint32 -- the CRC32C of every data and parity chunk
+        of every stripe, computed in the launch itself when the codec
+        exposes ``encode_batch_crc`` (device-fused; no host re-scan of
+        bytes the accelerator just touched) and by one host
+        ``crc32c_rows`` pass otherwise.  Callers fold them into
+        whole-shard CRCs with ``fold_chunk_crcs``.
+        """
+        return await self._submit("encode", codec, stripes, (),
+                                  want_crc=with_crc)
 
     async def decode(self, codec, erasures: tuple[int, ...],
                      survivors: np.ndarray) -> np.ndarray:
@@ -129,19 +140,22 @@ class CodecBatcher:
             self.perf.inc("fallback_ops")
 
     async def _submit(self, kind: str, codec, arr: np.ndarray,
-                      extra: tuple) -> np.ndarray:
+                      extra: tuple, want_crc: bool = False):
         arr = np.ascontiguousarray(arr, dtype=np.uint8)
         assert arr.ndim == 3, arr.shape
         if self._closed:
             # late stragglers during shutdown: launch solo
-            return self._launch_one(kind, codec, extra, arr)
+            out = self._launch_one(kind, codec, extra, arr)
+            if want_crc:
+                return out, self._host_chunk_crcs(arr, out)
+            return out
         key = codec_signature(codec, kind, extra)
         grp = self._groups.get(key)
         if grp is None:
             grp = self._groups[key] = _Group(codec, kind, extra)
         loop = asyncio.get_event_loop()
         fut = loop.create_future()
-        grp.items.append((arr, fut))
+        grp.items.append((arr, fut, want_crc))
         grp.n_stripes += arr.shape[0]
         if grp.n_stripes >= self.max_batch:
             self._flush(key, "full")
@@ -200,6 +214,19 @@ class CodecBatcher:
         return np.asarray(codec.decode_batch(list(extra), arr,
                                              out_np=True))
 
+    @staticmethod
+    def _host_chunk_crcs(data: np.ndarray,
+                         out: np.ndarray) -> np.ndarray:
+        """Host fallback for codecs without a fused CRC entry point:
+        still ONE batched pass over all chunks, never per-buffer."""
+        from ..ops.crc32c_batch import crc32c_rows
+        b, k, lane = data.shape
+        r = out.shape[1]
+        crcs = crc32c_rows(np.concatenate(
+            [data.reshape(b * k, lane), out.reshape(b * r, lane)]))
+        return np.concatenate([crcs[:b * k].reshape(b, k),
+                               crcs[b * k:].reshape(b, r)], axis=1)
+
     def _run_batch(self, grp: _Group, reason: str) -> None:
         # lazy: gf2kernels pulls in jax, which a replicated-only OSD
         # must not pay for at boot (only EC submissions reach here,
@@ -207,31 +234,58 @@ class CodecBatcher:
         from ..ops.gf2kernels import bucket_batch
         items = grp.items
         k = items[0][0].shape[1]
-        lane = max(a.shape[2] for a, _ in items)
-        total = sum(a.shape[0] for a, _ in items)
+        lane = max(a.shape[2] for a, _, _ in items)
+        total = sum(a.shape[0] for a, _, _ in items)
         b = bucket_batch(total)
-        payload = sum(a.size for a, _ in items)
+        payload = sum(a.size for a, _, _ in items)
         if len(items) == 1 and b == total:
             batch = items[0][0]
         else:
             batch = np.zeros((b, k, lane), np.uint8)
             row = 0
-            for a, _ in items:
+            for a, _, _ in items:
                 n, _, l = a.shape
                 batch[row:row + n, :, :l] = a
                 row += n
+        want_crc = any(w for _, _, w in items)
+        crcs = None
         try:
-            out = self._launch_one(grp.kind, grp.codec, grp.extra, batch)
+            if want_crc and grp.kind == "encode" \
+                    and hasattr(grp.codec, "encode_batch_crc") \
+                    and self._fused_crc_ok():
+                out, crcs = grp.codec.encode_batch_crc(batch)
+                out = np.asarray(out)
+                if self.perf is not None:
+                    self.perf.inc("crc_fused_launches")
+            else:
+                out = self._launch_one(grp.kind, grp.codec, grp.extra,
+                                       batch)
+                if want_crc:
+                    crcs = self._host_chunk_crcs(batch, out)
+                    if self.perf is not None:
+                        self.perf.inc("crc_host_batches")
         except Exception as e:
-            for _, fut in items:
+            for _, fut, _ in items:
                 if not fut.done():
                     fut.set_exception(e)
             return
         row = 0
-        for a, fut in items:
+        for a, fut, w in items:
             n, _, l = a.shape
             if not fut.done():
-                fut.set_result(out[row:row + n, :, :l])
+                res = out[row:row + n, :, :l]
+                if w:
+                    item_crcs = crcs[row:row + n]
+                    if l < lane:
+                        # chunk CRCs were computed at the padded lane
+                        # width; zero-extension is invertible, so strip
+                        # it instead of re-hashing the bytes
+                        from ..ops.crc32c_batch import crc32c_strip_zeros
+                        item_crcs = crc32c_strip_zeros(item_crcs,
+                                                       lane - l)
+                    fut.set_result((res, item_crcs))
+                else:
+                    fut.set_result(res)
             row += n
         if self.perf is not None:
             self.perf.inc("batches")
@@ -241,3 +295,8 @@ class CodecBatcher:
             self.perf.inc("pad_waste_bytes", b * k * lane - payload)
             self.perf.inc(f"flush_{reason}")
             self.perf.hist_sample("stripes_per_batch", total)
+
+    @staticmethod
+    def _fused_crc_ok() -> bool:
+        from ..ops.crc32c_batch import fused_enabled
+        return fused_enabled()

@@ -119,34 +119,64 @@ class StripeInfo:
                     else np.zeros(0, np.uint8))
                 for i, bufs in shards.items()}
 
-    async def encode_async(self, codec, data: bytes,
-                           batcher=None) -> dict[int, np.ndarray]:
+    async def encode_async(self, codec, data: bytes, batcher=None,
+                           with_crc: bool = False):
         """Batched analog of encode(): every stripe of ``data`` rides
         ONE ``encode_batch`` launch, and with a CodecBatcher the launch
         is shared with other concurrently-submitting ops (cross-PG
         coalescing).  Byte-identical to encode(); codecs without batch
-        entry points fall back transparently."""
+        entry points fall back transparently.
+
+        With ``with_crc`` the result is ``(shards, crcs)`` where
+        ``crcs[i]`` is the CRC32C of shard i's whole buffer: per-stripe
+        chunk CRCs come back from the codec launch itself (or one host
+        batched pass on fallback) and are folded across the stripe axis
+        with the GF(2) combine -- the write path stamps them without
+        ever re-hashing shard bytes.
+        """
         from .codec_batcher import CodecBatcher
         if batcher is None or not CodecBatcher.supports(codec):
             if batcher is not None:
                 batcher.note_fallback()
-            return self.encode(codec, data)
+            shards = self.encode(codec, data)
+            if not with_crc:
+                return shards
+            return shards, self._shard_crcs(shards)
         self._check_codec(codec)
         assert len(data) % self.stripe_width == 0, len(data)
         n = len(data) // self.stripe_width
         if n == 0:
-            return {i: np.zeros(0, np.uint8)
+            out0 = {i: np.zeros(0, np.uint8)
                     for i in range(self.k + self.m)}
+            if not with_crc:
+                return out0
+            return out0, self._shard_crcs(out0)
         arr = np.frombuffer(data, np.uint8).reshape(
             n, self.k, self.chunk_size)
-        parity = await batcher.encode(codec, arr)
+        if with_crc:
+            parity, chunk_crcs = await batcher.encode(codec, arr,
+                                                      with_crc=True)
+        else:
+            parity = await batcher.encode(codec, arr)
         out: dict[int, np.ndarray] = {}
         for i in range(self.k):
             out[i] = np.ascontiguousarray(arr[:, i]).reshape(-1)
         for r in range(self.m):
             out[self.k + r] = np.ascontiguousarray(
                 parity[:, r]).reshape(-1)
-        return out
+        if not with_crc:
+            return out
+        from ..ops.crc32c_batch import fold_chunk_crcs
+        folded = fold_chunk_crcs(chunk_crcs, self.chunk_size)
+        return out, {i: int(folded[i]) for i in range(self.k + self.m)}
+
+    @staticmethod
+    def _shard_crcs(shards: dict[int, np.ndarray]) -> dict[int, int]:
+        """Whole-shard CRCs in one batched pass (fallback path)."""
+        from ..ops.crc32c_batch import crc32c_batch
+        ids = sorted(shards)
+        crcs = crc32c_batch([shards[i] for i in ids])
+        return {i: int(c) for i, c in zip(ids, crcs)}
 
     async def decode_async(self, codec,
                            shard_bufs: Mapping[int, np.ndarray],

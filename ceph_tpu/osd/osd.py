@@ -127,6 +127,12 @@ class OSD:
         # with it); adopt them so `perf dump` includes the set.  A
         # full-map ingest re-adopts the fresh map's instance.
         self.perf.adopt(self.osdmap.placement_perf)
+        # the integrity pipeline's counters are process-wide (every
+        # CRC path -- codec batcher, scrub, blockstore, native scalar
+        # fallback -- reports to one set); adopt so `perf dump` shows
+        # batched vs scalar call mix
+        from ..ops.crc32c_batch import PERF as _integrity_perf
+        self.perf.adopt(_integrity_perf)
         # cross-PG EC codec aggregation stage: every ECBackend on this
         # OSD funnels encode/decode work through ONE batcher so
         # concurrent ops share accelerator launches

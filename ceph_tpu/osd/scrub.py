@@ -16,8 +16,13 @@ import hashlib
 import json
 from typing import Any
 
-from ..native import crc32c
+from ..ops.crc32c_batch import crc32c_batch
 from .backend import META_OID, ECBackend, SIZE_XATTR
+
+# objects digested per batched CRC call: bounds the payload bytes held
+# in RAM at once while keeping the per-call amortization (a collection
+# of any size still makes O(n/256) library calls, not O(n))
+_DIGEST_BATCH = 256
 
 
 async def build_scrub_map(store, coll: str,
@@ -26,9 +31,22 @@ async def build_scrub_map(store, coll: str,
 
     Async with periodic yields: digesting a whole PG synchronously
     would stall the event loop past the heartbeat grace and get the
-    daemon falsely reported down."""
+    daemon falsely reported down.  Deep-scrub data digests gather the
+    object payloads and go through ONE batched ``crc32c_batch`` call
+    per chunk of the collection instead of a scalar host call per
+    object (the last per-object CRC loop on the scrub path)."""
     import asyncio
     out: dict[str, dict] = {}
+    pending: list[tuple[str, bytes]] = []   # (oid, payload) awaiting CRC
+
+    def flush_digests() -> None:
+        if not pending:
+            return
+        crcs = crc32c_batch([p for _, p in pending])
+        for (oid2, _), crc in zip(pending, crcs):
+            out[oid2]["data_digest"] = int(crc)
+        pending.clear()
+
     for i, oid in enumerate(store.list_objects(coll)):
         if i % 16 == 15:
             await asyncio.sleep(0)
@@ -46,10 +64,12 @@ async def build_scrub_map(store, coll: str,
         entry["omap_digest"] = hashlib.sha1(
             json.dumps({k: v.hex() for k, v in sorted(omap.items())})
             .encode()).hexdigest()
-        if deep:
-            entry["data_digest"] = crc32c(
-                bytes(store.read(coll, oid, 0, None)))
         out[oid] = entry
+        if deep:
+            pending.append((oid, bytes(store.read(coll, oid, 0, None))))
+            if len(pending) >= _DIGEST_BATCH:
+                flush_digests()
+    flush_digests()
     return out
 
 
@@ -149,28 +169,35 @@ async def _repair_replicated(pg, oid: str, auth_osds: list[int],
 
 async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
     """Deep EC scrub: re-encode from k shards, compare all stored
-    shards byte-for-byte against the canonical encode."""
+    shards byte-for-byte against the canonical encode.
+
+    The canonical re-encode rides the per-OSD CodecBatcher (one
+    ``encode_batch`` launch per object instead of a per-stripe host
+    loop) and the per-shard CRC tag checks digest all gathered shard
+    buffers through one ``crc32c_batch`` call per object."""
     import numpy as np
     res = ScrubResult(pg.pgid)
     backend: ECBackend = pg.backend
     oids = [o for o in pg.osd.store.list_objects(pg.coll)
             if o != META_OID]
     res.objects_scrubbed = len(oids)
-    from .backend import CRC_XATTR, SHARD_XATTR, VER_XATTR, shard_crc
+    from .backend import (CRC_XATTR, SHARD_XATTR, VER_XATTR, shard_crc,
+                          shard_crc_matches)
     for oid in oids:
         bufs, size, ver = await backend._gather_shards(
             oid, need_shards=set(range(backend.k)))
         if not bufs:
             continue
-        logical = backend.sinfo.reconstruct_logical(backend.codec, bufs)
+        logical = await backend.sinfo.reconstruct_logical_async(
+            backend.codec, bufs, batcher=backend.batcher)
         pad = backend.sinfo.logical_to_next_stripe_offset(size)
-        canonical = backend.sinfo.encode(
-            backend.codec, logical[:pad].ljust(pad, b"\0"))
+        canonical = await backend.sinfo.encode_async(
+            backend.codec, logical[:pad].ljust(pad, b"\0"),
+            batcher=backend.batcher)
         # fetch every stored shard; compare bytes AND the write-time
         # identity tags (shard label / crc) the degraded-read path
         # trusts -- scrub is where silent tag rot gets caught
-        bad_shards: list[int] = []
-        bad_tags: list[int] = []
+        stored: list[tuple[int, bytes, object, object]] = []
         for shard, osd_id in enumerate(pg.acting):
             if osd_id < 0 or not pg.osd.osd_is_up(osd_id):
                 continue
@@ -194,11 +221,17 @@ async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
                        if replies[0].segments else b"")
                 label = replies[0].data.get("shard")
                 crc = replies[0].data.get("crc")
+            stored.append((shard, bytes(raw), label, crc))
+        have_crcs = crc32c_batch([raw for _, raw, _, _ in stored])
+        bad_shards: list[int] = []
+        bad_tags: list[int] = []
+        for (shard, raw, label, crc), have in zip(stored, have_crcs):
             want = canonical[shard].tobytes()
-            if bytes(raw) != want:
+            if raw != want:
                 bad_shards.append(shard)
             elif (label is not None and int(label) != shard) or \
-                    (crc is not None and crc != shard_crc(raw)):
+                    not shard_crc_matches(raw, crc,
+                                          precomputed=int(have)):
                 bad_tags.append(shard)
         if bad_shards or bad_tags:
             res.inconsistent[oid] = {"bad_shards": bad_shards,

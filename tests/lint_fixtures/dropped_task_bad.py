@@ -1,0 +1,12 @@
+"""Bad: fire-and-forget tasks dropped on the floor (silent death)."""
+
+import asyncio
+
+
+async def serve():
+    pass
+
+
+async def boot(loop):
+    asyncio.ensure_future(serve())       # exception never retrieved
+    loop.create_task(serve())            # GC may cancel it mid-flight

@@ -1,0 +1,29 @@
+"""Good: every spawned task is referenced, awaited, or callback'd."""
+
+import asyncio
+
+
+async def serve():
+    pass
+
+
+def on_death(task):
+    if not task.cancelled():
+        task.exception()
+
+
+class Daemon:
+    def __init__(self, loop):
+        self._tasks = []
+        # kept on an attribute: the daemon owns the lifetime
+        self._tick = asyncio.ensure_future(serve())
+        # tracked through a helper that also prunes on completion
+        self._tasks.append(loop.create_task(serve()))
+
+    async def run(self, loop):
+        # awaited inline: failures propagate to the caller
+        await asyncio.create_task(serve())
+        # immediate done-callback: death is observed
+        asyncio.ensure_future(serve()).add_done_callback(on_death)
+        t = loop.create_task(serve())
+        t.add_done_callback(on_death)

@@ -48,6 +48,46 @@ def test_import_does_not_flip_global_x64():
     assert jax.config.jax_enable_x64 is False
 
 
+def test_probe_skip_on_cpu_platform_and_env_override(monkeypatch):
+    """The ~225 s probe-retry window is skipped outright when the
+    backend is in-process (JAX_PLATFORMS=cpu) or the operator set
+    CEPH_TPU_BENCH_PROBE_WINDOW<=0 (BENCH_r05 burned the full window
+    to conclude 'stale fallback')."""
+    bench = _bench()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert bench._probe_skip_reason() is not None
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    assert bench._probe_skip_reason() is None
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench._probe_skip_reason() is None
+    monkeypatch.setenv("CEPH_TPU_BENCH_PROBE_WINDOW", "0")
+    assert bench._probe_skip_reason() is not None
+    monkeypatch.setenv("CEPH_TPU_BENCH_PROBE_WINDOW", "45")
+    assert bench._probe_skip_reason() is None
+
+
+def test_integrity_smoke_exits_zero_with_parity_and_counters():
+    """bench.py --integrity --smoke is the tier-1 tripwire for the
+    batched CRC pipeline: every backend must match the scalar oracle,
+    and the codec-batcher + deep-scrub proof paths must record ZERO
+    scalar CRC calls."""
+    import json
+    import os
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--integrity", "--smoke"],
+        capture_output=True, text=True, cwd="/root/repo", env=env,
+        timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    res = json.loads(r.stdout.strip().splitlines()[-1])
+    assert res["metric"] == "integrity_crc32c_batched_GiBps"
+    assert res["scalar_calls_on_batched_paths"] == 0
+    assert res["value"] > 0
+    assert res["fused_launches"] >= 1
+
+
 def test_placement_smoke_exits_zero_with_fused_parity():
     """bench.py --placement --smoke is the tier-1 tripwire for
     fused/scalar placement divergence: it forces the fused path on a

@@ -24,6 +24,8 @@ TREE_PATHS = ["ceph_tpu", "tools", "bench.py"]
 BASELINE = os.path.join(REPO, "tools", "lint_baseline.txt")
 
 RULE_FIXTURES = {
+    "dropped-task": ("dropped_task_bad.py",
+                     "dropped_task_good.py"),
     "hole-sentinel": ("hole_sentinel_bad.py",
                       "hole_sentinel_good.py"),
     "x64-scope": ("x64_scope_bad.py", "x64_scope_good.py"),
@@ -168,7 +170,7 @@ def test_cli_full_tree_exits_zero():
     assert res.stdout.strip() == ""
 
 
-def test_cli_list_rules_names_all_six():
+def test_cli_list_rules_names_every_rule():
     res = _cli("--list-rules")
     assert res.returncode == 0
     for rule in RULE_FIXTURES:

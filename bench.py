@@ -19,7 +19,11 @@ Secondary configs (each its own entry under "configs"):
 Modes: --osd-path drives the OSD data path (see _osd_path_mode);
 --placement measures the epoch-memoized placement cache -- bulk
 epoch-recompute throughput (pg/s) vs the per-PG scalar loop plus
-cached lookup latency (--smoke = tier-1 fused-parity tripwire).
+cached lookup latency (--smoke = tier-1 fused-parity tripwire);
+--cluster runs the closed-loop traffic harness (ceph_tpu/loadgen):
+a client swarm against an in-process >=64-OSD cluster with an OSD
+kill mid-run, reporting ops/s + tail latency per op class and
+recovery interference (--smoke = tier-1 zero-failed-ops tripwire).
 
 vs_baseline is the repo's own native C++ AVX2 encoder (native/gf8.cc,
 ISA-L's split-nibble SIMD technique, single thread) -- stated plainly:
@@ -57,6 +61,10 @@ RESULT = {
     "vs_baseline": 0.0,
 }
 _EMITTED = False
+# stale fallback (last-known-good TPU capture) only makes sense for
+# the default EC-throughput metric: a --cluster/--placement/... run
+# that dies must report ITS error, not resurrect an unrelated number
+_ALLOW_STALE = True
 
 
 def log(msg: str) -> None:
@@ -73,7 +81,8 @@ def emit() -> None:
 
 def _alarm(signum, frame):  # backstop: never die without the JSON line
     log("ALARM: hard deadline hit, emitting current result")
-    if not RESULT["value"] and _emit_stale("hard deadline mid-run"):
+    if _ALLOW_STALE and not RESULT["value"] \
+            and _emit_stale("hard deadline mid-run"):
         os._exit(3)
     RESULT.setdefault("error", "hard deadline")
     emit()
@@ -92,7 +101,8 @@ def _watchdog(deadline: float) -> None:
     if _EMITTED:      # close the race: main emitted during the check
         return
     log("WATCHDOG: main thread wedged (backend hang?); emitting")
-    if not RESULT["value"] and _emit_stale("watchdog: backend hang"):
+    if _ALLOW_STALE and not RESULT["value"] \
+            and _emit_stale("watchdog: backend hang"):
         os._exit(4)
     RESULT.setdefault("error", "watchdog: backend hang")
     emit()
@@ -776,6 +786,108 @@ def _integrity_mode(deadline: float, smoke: bool) -> int:
     return 0
 
 
+def _cluster_spec(smoke: bool):
+    """The --cluster WorkloadSpec: smoke = small, deterministic,
+    tier-1-fast; full = the >=64-OSD / >=10k-object acceptance shape
+    (BENCH_CLUSTER_* env overrides for exploration)."""
+    from ceph_tpu.loadgen import WorkloadSpec
+
+    if smoke:
+        return WorkloadSpec(
+            n_osds=5, pg_num=32, n_objects=96, obj_size=8 << 10,
+            n_ops=400, n_clients=8, recovery_ops=160, kill_osds=1,
+            seed=7).validate()
+    return WorkloadSpec(
+        n_osds=int(os.environ.get("BENCH_CLUSTER_OSDS", "64")),
+        pg_num=int(os.environ.get("BENCH_CLUSTER_PGS", "256")),
+        n_objects=int(os.environ.get("BENCH_CLUSTER_OBJECTS", "10000")),
+        obj_size=int(os.environ.get("BENCH_CLUSTER_OBJ_KIB", "16")) << 10,
+        n_ops=int(os.environ.get("BENCH_CLUSTER_OPS", "6000")),
+        n_clients=int(os.environ.get("BENCH_CLUSTER_CLIENTS", "32")),
+        recovery_ops=int(os.environ.get("BENCH_CLUSTER_REC_OPS",
+                                        "1200")),
+        kill_osds=1, size_dist="lognormal",
+        seed=int(os.environ.get("BENCH_CLUSTER_SEED", "1"))).validate()
+
+
+def _cluster_mode(deadline: float, smoke: bool) -> int:
+    """--cluster: the closed-loop traffic harness (ceph_tpu/loadgen)
+    against an in-process cluster — ops/s, GiB/s, p50/p95/p99/p99.9
+    per op class, and client-latency degradation across an OSD
+    kill/revive (degraded + backfill interference phases), with the
+    dmClock per-class dispatch counts showing client-vs-recovery QoS
+    behavior.  --smoke is the tier-1 tripwire: any failed/wedged
+    client op, a non-converging cluster, or a degenerate latency
+    distribution (p50 >= max, empty class) exits non-zero."""
+    import asyncio
+    from ceph_tpu.loadgen import (degradation_ratios, run_workload,
+                                  deterministic_view)
+
+    spec = _cluster_spec(smoke)
+    log(f"cluster mode: {spec.n_osds} osds, {spec.n_objects} objects,"
+        f" {spec.n_ops} steady ops, smoke={smoke}")
+    report = asyncio.new_event_loop().run_until_complete(
+        run_workload(spec, log=log))
+
+    phases = report["phases"]
+    failed = sum(ph.get("failed_ops", 0) for ph in phases.values())
+    wedged = sum(ph.get("wedged_ops", 0) for ph in phases.values())
+    steady = phases["steady"]["timing"]
+    total_ops = sum(ph["ops"] for ph in phases.values())
+    total_bytes = sum(ph["bytes_read"] + ph["bytes_written"]
+                      for ph in phases.values())
+    degr = {p: degradation_ratios(report, p)
+            for p in ("degraded", "backfill") if p in phases}
+    qos = report["qos"]
+    import hashlib
+    det_digest = hashlib.sha256(json.dumps(
+        deterministic_view(report), sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+    RESULT.update({
+        "metric": "cluster_steady_client_ops_per_s",
+        "value": steady["ops_per_s"],
+        "unit": "ops/s",
+        "vs_baseline": 0.0,
+        "steady_GiBps": steady["GiBps"],
+        "latency": steady["latency"],
+        "p99_degradation": degr,
+        "interference": report.get("interference"),
+        "qos": qos,
+        "total_ops": total_ops,
+        "total_GiB": round(total_bytes / 2**30, 3),
+        "failed_ops": failed,
+        "wedged_ops": wedged,
+        "osds": spec.n_osds,
+        "objects": spec.n_objects,
+        "pg_num": spec.pg_num,
+        "deterministic_digest": det_digest,
+        "schedule": report["schedule"],
+        "counters": report["counters"],
+        "timing": report["timing"],
+        "smoke": smoke,
+    })
+    emit()
+
+    rc = 0
+    if failed or wedged:
+        log(f"ERROR: {failed} failed / {wedged} wedged client ops")
+        rc = 1
+    interference = report.get("interference") or {}
+    if spec.recovery_ops and not (interference.get("down_detected")
+                                  and interference.get("revived")):
+        log("ERROR: interference phase never saw the kill/revive")
+        rc = 1
+    for kind, lat in steady["latency"].items():
+        if lat["count"] and lat["p50_s"] > lat["max_s"]:
+            log(f"ERROR: degenerate {kind} latency distribution")
+            rc = 1
+    if not qos.get("steady", {}).get("dispatched_client"):
+        log("ERROR: scheduler perf set recorded no client dispatch")
+        rc = 1
+    return rc
+
+
 def _osd_path_mode(deadline: float) -> int:
     """--osd-path: drive the OSD DATA PATH — concurrent client EC
     writes through an in-process mon+OSD cluster — instead of the raw
@@ -816,11 +928,18 @@ def main() -> int:
     threading.Thread(target=_watchdog, args=(deadline,),
                      daemon=True).start()
 
+    global _ALLOW_STALE
     if "--osd-path" in sys.argv[1:] or os.environ.get("BENCH_OSD_PATH"):
+        _ALLOW_STALE = False
         return _osd_path_mode(deadline)
+    if "--cluster" in sys.argv[1:] or os.environ.get("BENCH_CLUSTER"):
+        _ALLOW_STALE = False
+        return _cluster_mode(deadline, "--smoke" in sys.argv[1:])
     if "--placement" in sys.argv[1:] or os.environ.get("BENCH_PLACEMENT"):
+        _ALLOW_STALE = False
         return _placement_mode(deadline, "--smoke" in sys.argv[1:])
     if "--integrity" in sys.argv[1:] or os.environ.get("BENCH_INTEGRITY"):
+        _ALLOW_STALE = False
         return _integrity_mode(deadline, "--smoke" in sys.argv[1:])
 
     skip = _probe_skip_reason()

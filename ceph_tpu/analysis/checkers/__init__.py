@@ -2,6 +2,7 @@
 
 from . import (        # noqa: F401
     blocking_under_lock,
+    config_schema,
     dropped_task,
     hole_sentinel,
     jit_stability,

@@ -106,6 +106,72 @@ DEFAULT_SCHEMA: list[Option] = [
            "sources before erroring the read", min=0),
     Option("osd_ec_read_backoff", OPT_FLOAT, 0.25,
            "base backoff between shard-gather retry rounds", min=0.0),
+    Option("osd_max_backfills", OPT_INT, 2,
+           "concurrent backfill reservations per OSD (local+remote)",
+           min=1),
+    Option("osd_max_scrubs", OPT_INT, 1,
+           "concurrent scrub slots per OSD", min=1),
+    Option("osd_client_message_size_cap", OPT_INT, 500 << 20,
+           "in-flight client payload bytes before backpressure",
+           min=1),
+    Option("osd_op_complaint_time", OPT_FLOAT, 30.0,
+           "seconds in flight before an op is complained about",
+           min=0.1),
+    Option("osd_scrub_auto_repair", OPT_BOOL, True,
+           "repair scrub-detected inconsistencies automatically"),
+    Option("osd_ec_batch_enabled", OPT_BOOL, True,
+           "coalesce EC codec work across PGs into shared launches"),
+    Option("osd_ec_batch_max", OPT_INT, 64,
+           "max stripes per coalesced codec launch", min=1),
+    Option("osd_ec_batch_timeout", OPT_FLOAT, 0.002,
+           "seconds a partial codec batch waits before flushing",
+           min=0.0),
+    Option("osd_ec_batch_eager_flush", OPT_BOOL, True,
+           "flush the codec batch when the event loop goes idle"),
+    Option("osd_heartbeat_max_peers", OPT_INT, 10,
+           "heartbeat fanout cap: PG peers + id-ring neighbors "
+           "instead of the O(N^2) full mesh (0 = uncapped)", min=0),
+    Option("mon_up_thru_batch_window", OPT_FLOAT, 0.05,
+           "seconds the leader coalesces up_thru bumps before "
+           "committing them as one epoch (per-PG epoch storms on "
+           "pool create otherwise)", min=0.0),
+    Option("auth_service_ticket_ttl", OPT_FLOAT, 3600.0,
+           "cephx service ticket lifetime seconds", min=1.0),
+    Option("auth_ticket_ttl", OPT_FLOAT, 600.0,
+           "cephx auth ticket lifetime seconds", min=1.0),
+    Option("prometheus_port", OPT_INT, 0,
+           "mgr prometheus exporter port (0 = ephemeral)", min=0),
+    Option("dashboard_enabled", OPT_BOOL, True,
+           "serve the mgr dashboard"),
+    Option("dashboard_port", OPT_INT, 0,
+           "mgr dashboard port (0 = ephemeral)", min=0),
+    Option("telemetry_on", OPT_BOOL, False,
+           "enable the mgr telemetry module"),
+    # -- loadgen (the cluster traffic harness, ceph_tpu/loadgen) ----------
+    Option("loadgen_rados_handles", OPT_INT, 8,
+           "Rados connections the client swarm multiplexes over",
+           min=1),
+    Option("loadgen_op_timeout", OPT_FLOAT, 30.0,
+           "per-op client deadline; exceeding it is a wedged op",
+           min=0.1),
+    Option("loadgen_open_max_inflight", OPT_INT, 1024,
+           "open-loop safety valve: max ops in flight before the "
+           "dispatcher stalls (stalls are reported, not hidden)",
+           min=1),
+    Option("loadgen_preload_concurrency", OPT_INT, 64,
+           "concurrent writes while preloading the working set",
+           min=1),
+    Option("loadgen_kill_osds", OPT_INT, 1,
+           "OSDs killed by the recovery-interference phase", min=0),
+    Option("loadgen_recovery_settle", OPT_FLOAT, 15.0,
+           "seconds allowed for the mon to mark the victim down",
+           min=0.1),
+    Option("loadgen_hist_growth", OPT_FLOAT, 2 ** 0.125,
+           "latency histogram bucket growth factor (bounds the "
+           "relative error of reported percentiles)", min=1.0001),
+    Option("loadgen_hist_min_s", OPT_FLOAT, 1e-5,
+           "latency histogram first bucket upper bound (seconds)",
+           min=1e-9),
     Option("debug_osd", OPT_INT, 1, "osd log verbosity", min=0, max=20,
            level=LEVEL_DEV),
     Option("debug_mon", OPT_INT, 1, "mon log verbosity", min=0, max=20,

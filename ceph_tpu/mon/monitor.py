@@ -93,6 +93,10 @@ class Monitor:
         self.subscribers: dict[str, object] = {}   # peer name -> Connection
         self.failure_reports: dict[int, set[str]] = defaultdict(set)
         self._pending_lock = asyncio.Lock()
+        self._boot_lock = asyncio.Lock()
+        self._pending_up_thru: set[int] = set()
+        self._up_thru_flush: asyncio.Future | None = None
+        self._up_thru_task: asyncio.Task | None = None
         self._tick_task: asyncio.Task | None = None
         self._down_since: dict[int, float] = {}
         # paxos-lite
@@ -558,7 +562,17 @@ class Monitor:
 
         Identity (uuid->id) and topology (id->host) come from the
         replicated MAP, so any elected leader resolves reboots
-        identically -- never from a single mon's in-memory registry."""
+        identically -- never from a single mon's in-memory registry.
+
+        Serialized: id assignment reads ``osdmap.max_osd`` and the
+        commit that bumps it happens inside ``propose`` -- two fresh
+        OSDs booting concurrently (the cluster harness boots in
+        batches) would otherwise both read the same ``max_osd`` and
+        claim the same id."""
+        async with self._boot_lock:
+            await self._h_osd_boot_locked(conn, msg)
+
+    async def _h_osd_boot_locked(self, conn, msg) -> None:
         uuid = msg.data["uuid"]
         host = msg.data.get("host", "host0")
         addr = msg.data["addr"]
@@ -672,15 +686,46 @@ class Monitor:
             return
         if want and self.is_leader and self.osdmap.is_up(osd):
             if self.osdmap.get_up_thru(osd) < want:
-                inc = Incremental(epoch=0)
-                inc.new_up_thru[osd] = self.osdmap.epoch
-                await self.propose(inc)
+                await self._up_thru_batched(osd)
             await conn.send(Message(
                 "osd_alive_reply",
                 {"osd_id": osd, "up_thru": self.osdmap.get_up_thru(osd),
                  "epoch": self.osdmap.epoch,
                  **({"fwd_tids": msg.data["fwd_tids"]}
                     if "fwd_tids" in msg.data else {})}))
+
+    async def _up_thru_batched(self, osd: int) -> None:
+        """Coalesce up_thru bumps into one proposal per window.
+
+        A pool create on a big cluster makes EVERY new PG's primary
+        request up_thru within milliseconds; one paxos epoch (and one
+        map-delta broadcast to every subscriber) per request is an
+        epoch storm -- hundreds of epochs x every OSD applying each.
+        OSDMonitor batches the same way via pending_inc: requests
+        arriving within mon_up_thru_batch_window commit as ONE epoch.
+        """
+        self._pending_up_thru.add(osd)
+        if self._up_thru_flush is None or self._up_thru_flush.done():
+            self._up_thru_flush = asyncio.get_event_loop() \
+                .create_future()
+            self._up_thru_task = asyncio.ensure_future(
+                self._flush_up_thru(self._up_thru_flush))
+        await self._up_thru_flush
+
+    async def _flush_up_thru(self, fut: asyncio.Future) -> None:
+        try:
+            await asyncio.sleep(float(self.config.get(
+                "mon_up_thru_batch_window", 0.05)))
+            batch, self._pending_up_thru = self._pending_up_thru, set()
+            inc = Incremental(epoch=0)
+            for o in batch:
+                if self.osdmap.is_up(o):
+                    inc.new_up_thru[o] = self.osdmap.epoch
+            if inc.new_up_thru:
+                await self.propose(inc)
+        finally:
+            if not fut.done():
+                fut.set_result(None)
 
     async def _h_osd_alive_reply(self, conn, msg) -> None:
         # mon side: a forwarded alive's reply coming back from the

@@ -133,6 +133,25 @@ class Incremental:
     # lock holders whose delayed writes are still in flight
     new_blocklist: dict[str, float] = field(default_factory=dict)
     old_blocklist: list[str] = field(default_factory=list)
+
+    def placement_neutral(self) -> bool:
+        """True when applying this incremental cannot change any PG's
+        up/acting: only liveness bookkeeping (up_thru), client fencing
+        (blocklist), identity/topology labels (uuid/host), snap
+        bookkeeping, EC profile registration or service payloads.
+        The placement cache survives such epochs untouched — on a
+        large cluster the peering storm after a pool create emits one
+        up_thru epoch per PG, and rebuilding a 64-OSD full-cluster
+        table on every daemon for each of them is minutes of CPU that
+        produce byte-identical tables."""
+        return not (self.new_up or self.new_down or self.new_in
+                    or self.new_out or self.new_weights
+                    or self.new_pools or self.removed_pools
+                    or self.new_crush is not None
+                    or self.new_max_osd is not None
+                    or self.new_pg_temp or self.new_pg_upmap_items
+                    or self.removed_pg_upmap_items)
+
     # other PaxosService payloads riding the SAME paxos commit (the
     # reference multiplexes every service over one paxos instance):
     # service -> {key: value-or-None(delete)}; applied by the Monitor's
@@ -494,10 +513,19 @@ class OSDMap:
             self.pg_upmap_items[pgid] = [tuple(i) for i in items]
         for pgid in inc.removed_pg_upmap_items:
             self.pg_upmap_items.pop(pgid, None)
-        # every incremental -- osd state, weights, pools, crush,
-        # pg_temp, upmap -- retires the memoized placement table and
-        # weight vector for the previous generation
+        # a placement-AFFECTING incremental -- osd state, weights,
+        # pools, crush, pg_temp, upmap -- retires the memoized
+        # placement table and weight vector for the previous
+        # generation; a placement-NEUTRAL one (up_thru/blocklist/...)
+        # carries both forward, so the peering storm after a pool
+        # create (one up_thru epoch per PG) costs zero rebuilds
+        pcache, weights = self._pcache, self._weights_memo
         self._mutation_gen += 1
+        if inc.placement_neutral():
+            if pcache is not None and pcache[0] == self._mutation_gen - 1:
+                self._pcache = (self._mutation_gen, pcache[1])
+            if weights is not None and weights[0] == self._mutation_gen - 1:
+                self._weights_memo = (self._mutation_gen, weights[1])
 
     # -- serialization ------------------------------------------------------
     def to_dict(self) -> dict:

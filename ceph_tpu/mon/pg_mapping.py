@@ -38,20 +38,55 @@ FUSED_MIN_LANES = int(os.environ.get("CEPH_TPU_PLACEMENT_FUSED_MIN",
                                      "2048"))
 
 
-def _vector_crush_for(crush_map, ruleno: int):
-    """Per-CrushMap cache of compiled VectorCrush instances.
+# structurally-identical maps share ONE compiled instance process-wide
+# (bounded: stale structures age out).  An in-process cluster runs one
+# CrushMap object PER DAEMON, all deserialized from the same mon map;
+# without structural sharing each of 64 OSDs would pay its own
+# multi-second jit compile for byte-identical hierarchies.
+_VC_SHARED: dict[tuple, object] = {}
+_VC_SHARED_MAX = 8
 
-    Keyed on the rule (and the identity of any choose_args override):
-    a CrushMap is replaced wholesale when the map changes
-    (apply_incremental new_crush), so stale compiles die with the old
-    object and the jit cache keyed on ``self`` stays warm across
-    epochs that only flip weights."""
+
+def _crush_digest(crush_map) -> str:
+    """Structural fingerprint of a CrushMap (buckets/rules/tunables/
+    choose_args), cached on the object (maps are replaced wholesale on
+    change, never mutated in place)."""
+    dig = crush_map.__dict__.get("_structure_digest")
+    if dig is None:
+        import hashlib
+        import json as _json
+        from .osdmap import crush_to_dict
+        # choose_args are baked into the compiled instance
+        # (CompiledMap.from_map falls back to map.choose_args) but are
+        # NOT part of crush_to_dict -- digest them explicitly
+        blob = _json.dumps(
+            {"crush": crush_to_dict(crush_map),
+             "choose_args": getattr(crush_map, "choose_args", None)},
+            sort_keys=True, default=str)
+        dig = hashlib.sha256(blob.encode()).hexdigest()
+        crush_map.__dict__["_structure_digest"] = dig
+    return dig
+
+
+def _vector_crush_for(crush_map, ruleno: int):
+    """Compiled VectorCrush for a (map, rule), shared two ways: per
+    CrushMap object (the jit stays warm across weight-only epochs),
+    and across structurally-identical maps process-wide (every daemon
+    of an in-process cluster deserializes its own copy of the same
+    map; one compile serves them all)."""
     cache = crush_map.__dict__.setdefault("_vc_cache", {})
     ca = getattr(crush_map, "choose_args", None)
     key = (ruleno, id(ca) if ca else None)
     if key not in cache:
-        from ..crush.vectorized import VectorCrush
-        cache[key] = VectorCrush(crush_map, ruleno)
+        shared_key = (_crush_digest(crush_map), ruleno)
+        vc = _VC_SHARED.get(shared_key)
+        if vc is None:
+            from ..crush.vectorized import VectorCrush
+            vc = VectorCrush(crush_map, ruleno)
+            while len(_VC_SHARED) >= _VC_SHARED_MAX:
+                _VC_SHARED.pop(next(iter(_VC_SHARED)))
+            _VC_SHARED[shared_key] = vc
+        cache[key] = vc
     return cache[key]
 
 
@@ -71,7 +106,17 @@ def bulk_crush(crush_map, ruleno: int, xs, numrep: int, weights,
     xs = np.asarray(xs, dtype=np.int64)
     lanes = int(xs.shape[0])
     threshold = FUSED_MIN_LANES if min_lanes is None else min_lanes
-    if fused == "always" or (fused == "auto" and lanes >= threshold):
+    # a WARM VectorCrush for this (map, rule) makes the fused launch
+    # all but free -- the threshold only guards the one-time
+    # trace/compile cost, so it does not apply once that cost is sunk
+    # (the epoch-recompute path hits the same map object dozens of
+    # times during peering/recovery churn on a big cluster)
+    ca = getattr(crush_map, "choose_args", None)
+    warm = ((ruleno, id(ca) if ca else None)
+            in crush_map.__dict__.get("_vc_cache", {})
+            or (_crush_digest(crush_map), ruleno) in _VC_SHARED)
+    if fused == "always" or (fused == "auto"
+                             and (warm or lanes >= threshold)):
         try:
             vc = _vector_crush_for(crush_map, ruleno)
             rows = np.asarray(vc.map_pgs(xs, numrep, list(weights)),
@@ -240,6 +285,10 @@ class PGMapping:
         the two tables (pool create/delete, pg_num resize).  Exactly
         the brute-force entry-for-entry diff, so a map consumer can
         retarget only what moved."""
+        if prev is self:
+            # placement-neutral epochs (up_thru/blocklist-only) carry
+            # the table object across generations: nothing moved
+            return []
         changed: list[tuple[int, int]] = []
         pools = set(self._up) | set(prev._up)
         for pool_id in sorted(pools):

@@ -97,7 +97,6 @@ class OSD:
         self.monmap: list[list] = []
         self.osdmap = OSDMap()
         self.pgs: dict[str, PG] = {}
-        self.sched = MClockScheduler()
         # backfill reservation slots (AsyncReserver.h / osd_max_backfills):
         # local = backfills this OSD primaries, remote = backfills
         # targeting this OSD
@@ -123,6 +122,16 @@ class OSD:
         # observability (src/common/perf_counters + TrackedOp analog)
         self.perf = PerfCountersCollection()
         self.perf_osd = self.perf.create("osd")
+        # dmClock admission with its own perf set: per-class queue
+        # depth gauges + dispatch counters, so a load harness can
+        # report client-vs-recovery QoS behavior instead of inferring
+        # it from latency alone
+        self.sched = MClockScheduler(perf=self.perf.create("scheduler"))
+        # the traffic harness's process-wide workload counters (ops and
+        # bytes the client swarm pushed); adopting them means a plain
+        # `perf dump` shows offered load next to what the daemon did
+        from ..loadgen.stats import PERF as _workload_perf
+        self.perf.adopt(_workload_perf)
         # the map owns the placement-cache counters (they live and die
         # with it); adopt them so `perf dump` includes the set.  A
         # full-map ingest re-adopts the fresh map's instance.
@@ -737,6 +746,52 @@ class OSD:
         except (ConnectionError, OSError, asyncio.TimeoutError):
             pass
 
+    def _heartbeat_peers(self) -> list[int]:
+        """Up peers this OSD pings, capped at osd_heartbeat_max_peers.
+
+        A full mesh is O(N^2) messages per interval — fine at 3 OSDs,
+        ruinous at the 64–1000 the cluster harness brings up.  The
+        reference picks heartbeat peers from hosted PGs plus map-order
+        neighbors (OSD::maybe_update_heartbeat_peers); we do the same:
+        PG peers (whose liveness gates OUR peering/recovery) first,
+        then ring neighbors by osd id so the detection graph stays
+        connected and every OSD is somebody's neighbor.
+        """
+        ups = sorted(o for o, info in self.osdmap.osds.items()
+                     if o != self.whoami and info.up)
+        cap = int(self.config.get("osd_heartbeat_max_peers", 10))
+        if cap <= 0 or len(ups) <= cap:
+            return ups
+        cap = max(cap, 4)
+        # ring neighbors FIRST: every up OSD is the +-1 neighbor of two
+        # others, so even a PG-less daemon has someone watching it
+        import bisect
+        i = bisect.bisect_left(ups, self.whoami)
+        n = len(ups)
+        peers: list[int] = []
+        seen: set[int] = set()
+
+        def add(o: int) -> None:
+            if o != self.whoami and o not in seen:
+                seen.add(o)
+                peers.append(o)
+
+        add(ups[i % n])              # i points past self (not in ups)
+        add(ups[(i - 1) % n])
+        add(ups[(i + 1) % n])
+        for pg in self.pgs.values():
+            if len(peers) >= cap:
+                break
+            for o in pg.up:
+                if o in self.osdmap.osds and self.osdmap.osds[o].up:
+                    add(o)
+        for step in range(2, n):
+            if len(peers) >= cap:
+                break
+            add(ups[(i + step) % n])
+            add(ups[(i - step) % n])
+        return peers[:cap]
+
     async def _heartbeat_once(self) -> None:
         now = time.monotonic()
         grace = self.config["osd_heartbeat_grace"]
@@ -793,8 +848,7 @@ class OSD:
             if pg.state == "active" and pg.pool.removed_snaps:
                 pg.kick_snap_trim(pg.pool.removed_snaps)
         self._maybe_schedule_scrubs(now)
-        peers = [osd for osd, info in self.osdmap.osds.items()
-                 if osd != self.whoami and info.up]
+        peers = self._heartbeat_peers()
         await asyncio.gather(*(self._ping_one(o, now) for o in peers),
                              return_exceptions=True)
         for osd in peers:

@@ -59,14 +59,43 @@ class _ClassState:
 
 class MClockScheduler:
     def __init__(self, specs: dict[OpClass, ClassSpec] | None = None,
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic, perf=None) -> None:
         self.clock = clock
+        self._last_now = float("-inf")
+        # observability sink (the OSD's "scheduler" perf set): queue
+        # depth per class as gauges, enqueue/dispatch totals per class
+        # as counters, so QoS behavior is REPORTED, not inferred
+        self.perf = perf
         self._seq = itertools.count()
         self.classes = {c: _ClassState(s)
                         for c, s in (specs or DEFAULT_SPECS).items()}
 
+    def _now(self) -> float:
+        """Clock read clamped against regression.
+
+        Tags are times: the default clock is ``time.monotonic`` (an
+        NTP step on the wall clock must never starve a class whose
+        tags suddenly sit in the future, nor burst one whose tags fell
+        into the past), and any injected clock gets the same guarantee
+        by clamping -- a backwards step freezes `now` instead of
+        rewinding the tag arithmetic.
+        """
+        now = self.clock()
+        if now < self._last_now:
+            now = self._last_now
+        else:
+            self._last_now = now
+        return now
+
     def __len__(self) -> int:
         return sum(len(st.queue) for st in self.classes.values())
+
+    def _note_depth(self, op_class: OpClass) -> None:
+        if self.perf is not None:
+            st = self.classes[op_class]
+            self.perf.set_gauge(f"depth_{op_class.value}",
+                                len(st.queue))
+            self.perf.set_gauge("depth_total", len(self))
 
     def enqueue(self, op_class: OpClass, item: Any) -> None:
         """Stamp the op with its own dmclock tags.
@@ -76,7 +105,7 @@ class MClockScheduler:
         `now`; a backlogged class spaces ops 1/rate apart.
         """
         st = self.classes[op_class]
-        now = self.clock()
+        now = self._now()
         sp = st.spec
         tags = _Tags(
             r=(max(st.prev.r + 1.0 / sp.reservation, now)
@@ -88,14 +117,18 @@ class MClockScheduler:
         )
         st.prev = tags
         heapq.heappush(st.queue, (next(self._seq), tags, item))
+        if self.perf is not None:
+            self.perf.inc(f"enqueued_{op_class.value}")
+            self._note_depth(op_class)
 
     def dequeue(self) -> tuple[OpClass, Any] | None:
         """Pick per dmclock, comparing HEAD-of-queue op tags:
         reservation tags that are due first, then weight tags among
         classes whose head op is under its limit.
         """
-        now = self.clock()
+        now = self._now()
         best_c, best_tag = None, None
+        lane = "reservation"
         for c, st in self.classes.items():
             if not st.queue:
                 continue
@@ -103,6 +136,7 @@ class MClockScheduler:
             if head.r <= now and (best_tag is None or head.r < best_tag):
                 best_c, best_tag = c, head.r
         if best_c is None:
+            lane = "weight"
             for c, st in self.classes.items():
                 if not st.queue:
                     continue
@@ -114,6 +148,7 @@ class MClockScheduler:
         if best_c is None:
             # every head op is limit-deferred: fall back to global FIFO
             # so the queue still drains (the real scheduler would wait)
+            lane = "fifo"
             candidates = [(st.queue[0][0], c)
                           for c, st in self.classes.items() if st.queue]
             if not candidates:
@@ -121,4 +156,8 @@ class MClockScheduler:
             best_c = min(candidates)[1]
         st = self.classes[best_c]
         _, _, item = heapq.heappop(st.queue)
+        if self.perf is not None:
+            self.perf.inc(f"dispatched_{best_c.value}")
+            self.perf.inc(f"lane_{lane}")
+            self._note_depth(best_c)
         return best_c, item

@@ -22,21 +22,24 @@ import sys
 import time
 
 from ..common.faults import MessageFaultInjector
-from ..mon import Monitor
+from ..loadgen.cluster import SimCluster
 from ..msg import Message, Messenger
-from ..osd import OSD
 from ..osd.backend import pack_mutations
 
 
-class ChaosCluster:
-    """Mon + N OSDs + a client messenger, with kill/revive helpers."""
+class ChaosCluster(SimCluster):
+    """SimCluster plus a raw client messenger for low-level op drives.
+
+    Bring-up, kill/revive tokens, wait_down/up/clean and perf
+    aggregation all come from the shared ``loadgen.cluster``
+    machinery; this subclass adds the bare-messenger client the chaos
+    rounds use to submit ops without librados in the way.
+    """
 
     def __init__(self, mon, osds, client,
                  faults: MessageFaultInjector | None = None) -> None:
-        self.mon = mon
-        self.osds = osds
+        super().__init__(mon, osds, faults=faults)
         self.client = client
-        self.faults = faults
         self._op_serial = 0
 
     @classmethod
@@ -45,19 +48,12 @@ class ChaosCluster:
                      osd_config: dict | None = None,
                      faults: MessageFaultInjector | None = None
                      ) -> "ChaosCluster":
-        mon = Monitor(rank=0, config={"mon_osd_min_down_reporters": 1,
-                                      **(mon_config or {})})
-        addr = await mon.start()
-        mon.peer_addrs = [addr]
-        osds = []
-        for i in range(n_osds):
-            osd = OSD(host=f"host{i}", config=osd_config,
-                      fault_injector=faults)
-            await osd.start(addr)
-            osds.append(osd)
+        base = await SimCluster.create(
+            n_osds, mon_config=mon_config, osd_config=osd_config,
+            faults=faults)
         client = Messenger("client.chaos")
         await client.bind()
-        return cls(mon, osds, client, faults)
+        return cls(base.mon, base.osds, client, faults)
 
     async def stop(self) -> None:
         for o in self.osds:
@@ -144,75 +140,6 @@ class ChaosCluster:
             raise TimeoutError(f"osd_op on {oid} never succeeded")
         finally:
             self.client.dispatchers.remove(d)
-
-    # -- fault actions -------------------------------------------------------
-    async def kill_osd(self, index: int) -> dict:
-        """Stop an OSD, keeping what a revive needs."""
-        osd = self.osds[index]
-        token = {"uuid": osd.uuid, "whoami": osd.whoami,
-                 "store": osd.store, "host": osd.host,
-                 "config": dict(osd._base_config)}
-        await osd.stop()
-        return token
-
-    async def revive_osd(self, index: int, token: dict) -> None:
-        osd = OSD(uuid=token["uuid"], whoami=token["whoami"],
-                  store=token["store"], host=token["host"],
-                  config=token["config"], fault_injector=self.faults)
-        await osd.start(self.mon.msgr.addr)
-        self.osds[index] = osd
-
-    async def wait_down(self, osd_id: int, timeout: float = 30.0) -> bool:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if not self.mon.osdmap.is_up(osd_id):
-                return True
-            await asyncio.sleep(0.2)
-        return False
-
-    async def wait_up(self, osd_id: int, timeout: float = 30.0) -> bool:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self.mon.osdmap.is_up(osd_id):
-                return True
-            await asyncio.sleep(0.2)
-        return False
-
-    async def wait_clean(self, timeout: float = 30.0) -> bool:
-        """Best-effort wait until no primary has pending recovery (the
-        thrasher's wait-for-clean between actions): killing an OSD
-        while a laggard re-push is still in flight tests the durability
-        floor, not the read path."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            busy = False
-            for osd in self.osds:
-                for pg in osd.pgs.values():
-                    if not pg.is_primary():
-                        continue
-                    if pg.state != "active" or pg._recovery_pending():
-                        busy = True
-                        break
-                if busy:
-                    break
-            if not busy:
-                return True
-            await asyncio.sleep(0.2)
-        return False
-
-    def perf_counters(self, which: str) -> dict:
-        """Aggregated counter set across live OSDs (e.g. 'ec_degraded',
-        'fault_inject')."""
-        out: dict[str, int] = {}
-        for osd in self.osds:
-            pc = osd.perf.get(which)
-            if pc is None:
-                continue
-            for key, val in pc.dump().items():
-                if isinstance(val, (int, float)):
-                    out[key] = out.get(key, 0) + val
-        return out
-
 
 async def run_round(c: ChaosCluster, *, rnd: random.Random,
                     pool: str, n_objects: int, min_size: int,

@@ -1,0 +1,14 @@
+"""BAD: config keys read that no schema/defaults table declares."""
+
+
+class Daemon:
+    def __init__(self, conf):
+        self.conf = conf
+        self.config = {}
+
+    def tick(self):
+        # typo'd knob: the inline default absolves it forever
+        return self.conf.get("daemon_bogus_grace", 4.0)
+
+    def interval(self):
+        return self.config["daemon_missing_interval"]

@@ -1,0 +1,29 @@
+"""GOOD: every key read is declared (Option() or a defaults table)."""
+
+SCHEMA = [
+    Option("daemon_tick_interval", "float", 0.5, "tick cadence"),
+]
+
+
+class Daemon:
+    def __init__(self, conf, config=None):
+        self.conf = conf
+        # the defaults-table declaration form
+        self.config = {
+            "daemon_report_grace": 4.0,
+            **(config or {}),
+        }
+
+    def tick(self):
+        return self.conf.get("daemon_tick_interval", 0.5)
+
+    def grace(self):
+        return self.config["daemon_report_grace"]
+
+    def dynamic(self, name):
+        return self.conf.get(name)      # non-literal keys out of scope
+
+    def unrelated(self, config):
+        # single-word keys on dicts that merely happen to be called
+        # `config` (e.g. rgw notification configs) are out of scope
+        return config.get("events", [])

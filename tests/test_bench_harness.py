@@ -88,6 +88,36 @@ def test_integrity_smoke_exits_zero_with_parity_and_counters():
     assert res["fused_launches"] >= 1
 
 
+def test_cluster_smoke_exits_zero_with_no_failed_ops():
+    """bench.py --cluster --smoke is the tier-1 tripwire for the
+    traffic harness: a small deterministic swarm + OSD kill/revive
+    must complete with ZERO failed/wedged client ops, non-degenerate
+    latency (p50 <= p99), interference phases that actually saw the
+    kill, and dmClock client dispatches recorded."""
+    import json
+    import os
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--cluster", "--smoke"],
+        capture_output=True, text=True, cwd="/root/repo", env=env,
+        timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    res = json.loads(r.stdout.strip().splitlines()[-1])
+    assert res["metric"] == "cluster_steady_client_ops_per_s"
+    assert res["value"] > 0
+    assert res["failed_ops"] == 0 and res["wedged_ops"] == 0
+    for kind in ("read", "write", "rmw"):
+        lat = res["latency"][kind]
+        assert lat["count"] > 0
+        assert lat["p50_s"] <= lat["p99_s"] <= lat["max_s"]
+    assert res["interference"]["down_detected"]
+    assert res["interference"]["revived"]
+    assert res["qos"]["steady"]["dispatched_client"] > 0
+    assert res["p99_degradation"]["degraded"]
+
+
 def test_placement_smoke_exits_zero_with_fused_parity():
     """bench.py --placement --smoke is the tier-1 tripwire for
     fused/scalar placement divergence: it forces the fused path on a

@@ -80,3 +80,72 @@ def test_empty():
     sched, _ = mk()
     assert sched.dequeue() is None
     assert len(sched) == 0
+
+
+def test_default_clock_is_monotonic():
+    """Tags are spaced in time: they must come from time.monotonic,
+    never the NTP-steppable wall clock (a backwards step would let a
+    class burst past its limit; a forward step would starve it)."""
+    import time
+    assert MClockScheduler().clock is time.monotonic
+
+
+def test_tags_survive_backwards_clock_jump():
+    """Regression: a clock that steps backwards (a mocked NTP jump)
+    must not rewind tag arithmetic -- every tag stays monotonically
+    non-decreasing within its class, and dequeue still drains."""
+    sched, clock = mk()
+    sched.enqueue(OpClass.CLIENT, "before")
+    tags0 = sched.classes[OpClass.CLIENT].prev
+    clock.t -= 90.0                       # the step
+    sched.enqueue(OpClass.CLIENT, "after")
+    tags1 = sched.classes[OpClass.CLIENT].prev
+    assert tags1.r >= tags0.r
+    assert tags1.w >= tags0.w
+    assert tags1.l >= tags0.l
+    # dequeue's `now` is clamped too: the queue drains in order
+    # rather than seeing every tag as far-future
+    out = [sched.dequeue()[1] for _ in range(2)]
+    assert out == ["before", "after"]
+    assert sched.dequeue() is None
+
+
+def test_forward_jump_does_not_burst_limited_class():
+    """After a FORWARD jump a limited class restarts at `now` but its
+    successive ops still space 1/limit apart -- the jump must not
+    grant a burst beyond one op's worth of credit."""
+    specs = {
+        OpClass.BEST_EFFORT: ClassSpec(reservation=0.0, weight=1.0,
+                                       limit=10.0),   # 0.1s spacing
+    }
+    sched, clock = mk(specs)
+    sched.enqueue(OpClass.BEST_EFFORT, "a")
+    clock.t += 1000.0
+    sched.enqueue(OpClass.BEST_EFFORT, "b")
+    sched.enqueue(OpClass.BEST_EFFORT, "c")
+    st = sched.classes[OpClass.BEST_EFFORT]
+    tags = sorted(t.l for _, t, _ in st.queue)
+    # b restarted at the new now; c is held 1/limit behind b
+    assert tags[2] - tags[1] >= 0.1 - 1e-9
+
+
+def test_perf_sink_records_depth_and_dispatch():
+    from ceph_tpu.common.perf import PerfCounters
+
+    pc = PerfCounters("scheduler")
+    clock = FakeClock()
+    sched = MClockScheduler(clock=clock, perf=pc)
+    sched.enqueue(OpClass.CLIENT, "c0")
+    sched.enqueue(OpClass.RECOVERY, "r0")
+    dump = pc.dump()
+    assert dump["enqueued_client"] == 1
+    assert dump["enqueued_recovery"] == 1
+    assert dump["depth_total"] == 2
+    while sched.dequeue() is not None:
+        pass
+    dump = pc.dump()
+    assert dump["dispatched_client"] == 1
+    assert dump["dispatched_recovery"] == 1
+    assert dump["depth_total"] == 0
+    assert dump["lane_reservation"] + dump.get("lane_weight", 0) \
+        + dump.get("lane_fifo", 0) == 2

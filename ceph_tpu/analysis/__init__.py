@@ -25,13 +25,19 @@ from .core import (          # noqa: F401
     Module,
     Project,
     baseline_key,
+    changed_closure,
     collect_files,
     filter_suppressed,
     load_baseline,
     run,
     write_baseline,
 )
-from .registry import Checker, get_checkers, register   # noqa: F401
+from .registry import (      # noqa: F401
+    Checker,
+    ProjectChecker,
+    get_checkers,
+    register,
+)
 
 # Importing the subpackage registers every built-in rule.
 from . import checkers       # noqa: F401,E402

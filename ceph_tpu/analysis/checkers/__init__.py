@@ -3,9 +3,13 @@
 from . import (        # noqa: F401
     blocking_under_lock,
     config_schema,
+    counter_coverage,
+    denc_symmetry,
+    device_path,
     dropped_task,
     hole_sentinel,
     jit_stability,
+    lock_order,
     perf_coherence,
     tracer_safety,
     x64_scope,

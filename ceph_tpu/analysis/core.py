@@ -18,11 +18,12 @@ from __future__ import annotations
 import ast
 import io
 import os
+import time
 import tokenize
 from dataclasses import dataclass, field
 from typing import Iterable
 
-from .registry import get_checkers
+from .registry import ProjectChecker, get_checkers
 
 _SUPPRESS_PREFIX = "lint:"
 _SKIP_DIRS = {"__pycache__", ".git", ".hg", "node_modules"}
@@ -69,9 +70,19 @@ class Module:
 @dataclass
 class Project:
     modules: list[Module]
+    # resolved CallGraph, set by run() when a ProjectChecker ran (or
+    # on demand via graph()); callers may also build it themselves
+    callgraph: object | None = None
 
     def by_path(self) -> dict[str, Module]:
         return {m.path: m for m in self.modules}
+
+    def graph(self):
+        """The interprocedural CallGraph, built on first use."""
+        if self.callgraph is None:
+            from .callgraph import CallGraph
+            self.callgraph = CallGraph.build(self)
+        return self.callgraph
 
 
 def _scan_suppressions(source: str) -> dict[int, set[str]]:
@@ -96,7 +107,9 @@ def _scan_suppressions(source: str) -> dict[int, set[str]]:
         directive = text[len(_SUPPRESS_PREFIX):].strip()
         if not directive.startswith("disable"):
             continue
-        rest = directive[len("disable"):].strip()
+        # "disable=<rules> -- why": the justification rides the
+        # directive so the hop and its reason live on one line
+        rest = directive[len("disable"):].split("--", 1)[0].strip()
         if rest.startswith("="):
             rules = {r.strip() for r in rest[1:].split(",")
                      if r.strip()}
@@ -179,11 +192,19 @@ def write_baseline(path: str, findings: Iterable[Finding]) -> None:
 
 def run(paths: Iterable[str], root: str | None = None,
         rules: Iterable[str] | None = None,
+        profile: dict[str, float] | None = None,
         ) -> tuple[list[Finding], Project]:
     """Parse every file once, run the checkers, return raw findings
-    (suppressions and baseline NOT yet applied) plus the project."""
+    (suppressions and baseline NOT yet applied) plus the project.
+
+    Per-module rules see each ``Module``; ``ProjectChecker`` rules
+    additionally get the resolved ``CallGraph`` (built once, only
+    when such a rule is selected).  Pass a dict as ``profile`` to get
+    per-rule wall seconds (plus ``[parse]`` / ``[callgraph]``).
+    """
     findings: list[Finding] = []
     modules: list[Module] = []
+    t0 = time.perf_counter()
     for abspath, display in collect_files(paths, root):
         try:
             modules.append(Module.parse(abspath, display))
@@ -191,12 +212,47 @@ def run(paths: Iterable[str], root: str | None = None,
             findings.append(Finding(display, e.lineno or 1, "parse",
                                     f"syntax error: {e.msg}"))
     project = Project(modules)
-    for checker in get_checkers(rules):
+    if profile is not None:
+        profile["[parse]"] = time.perf_counter() - t0
+    checkers = get_checkers(rules)
+    if any(isinstance(c, ProjectChecker) for c in checkers):
+        t0 = time.perf_counter()
+        project.graph()
+        if profile is not None:
+            profile["[callgraph]"] = time.perf_counter() - t0
+    for checker in checkers:
+        t0 = time.perf_counter()
         for mod in project.modules:
             if checker.scope(mod):
                 findings.extend(checker.check(mod))
         findings.extend(checker.finalize(project))
+        if isinstance(checker, ProjectChecker):
+            findings.extend(checker.check_project(project.graph()))
+        if profile is not None:
+            profile[checker.name] = (profile.get(checker.name, 0.0)
+                                     + time.perf_counter() - t0)
     return sorted(findings), project
+
+
+def changed_closure(project: Project, dirty: Iterable[str],
+                    max_fanout: int = 8) -> set[str]:
+    """Expand a set of dirty file paths with every module holding a
+    (transitive) caller of anything the dirty modules define -- the
+    re-analysis set for ``lint.py --changed``: an edit to a callee can
+    surface interprocedural findings in callers that did not change.
+    """
+    graph = project.graph()
+    dirty = set(dirty)
+    targets = [q for q, fi in graph.functions.items()
+               if fi.path in dirty]
+    targets += [graph.module_root(p) for p in dirty
+                if p in graph.symbols]
+    out = set(dirty)
+    for qual in graph.callers(targets, max_fanout=max_fanout):
+        fi = graph.functions.get(qual)
+        if fi is not None:
+            out.add(fi.path)
+    return out
 
 
 def filter_suppressed(findings: Iterable[Finding], project: Project,

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import TYPE_CHECKING, Iterable
 
 if TYPE_CHECKING:                         # pragma: no cover
+    from .callgraph import CallGraph
     from .core import Finding, Module, Project
 
 
@@ -35,6 +36,22 @@ class Checker:
 
     def finalize(self, project: "Project") -> Iterable["Finding"]:
         """Cross-module pass, called once after every check()."""
+        return ()
+
+
+class ProjectChecker(Checker):
+    """Base class for whole-program rules.
+
+    Besides the per-module hooks, a ProjectChecker receives the
+    resolved ``CallGraph`` (symbol table, call/reference edges with
+    fan-out, lock regions, reachability queries) once per run.  The
+    graph is built lazily: it only costs anything when at least one
+    registered ProjectChecker is selected.
+    """
+
+    def check_project(self,
+                      graph: "CallGraph") -> Iterable["Finding"]:
+        """Whole-program pass over the resolved call graph."""
         return ()
 
 

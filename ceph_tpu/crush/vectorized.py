@@ -497,5 +497,7 @@ class VectorCrush:
             xs = jnp.asarray(xs, jnp.int32)
             w = jnp.asarray(osd_weights, jnp.int32)
             if self.firstn:
+                # lint: disable=device-path-host-sync -- the single post-launch materialization of the bulk map
                 return np.asarray(self.map_firstn(xs, numrep, w))
+            # lint: disable=device-path-host-sync -- the single post-launch materialization of the bulk map
             return np.asarray(self.map_indep(xs, numrep, w))

@@ -160,6 +160,7 @@ def coeff_to_bitmatrix(c: int) -> np.ndarray:
 
 def matrix_to_bitmatrix(mat: np.ndarray) -> np.ndarray:
     """Expand an (r,k) GF(2^8) coefficient matrix to its (8r,8k) GF(2) form."""
+    # lint: disable=device-path-host-sync -- (r,k) coefficient matrix at codec setup, not batch payload
     mat = np.asarray(mat, dtype=np.uint8)
     r, k = mat.shape
     out = np.zeros((8 * r, 8 * k), dtype=np.uint8)

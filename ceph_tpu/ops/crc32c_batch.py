@@ -82,6 +82,7 @@ def _tables() -> np.ndarray:
 
 def _mat_apply(mat: np.ndarray, v) -> np.ndarray:
     """Apply a (32,) column-matrix to a scalar/array of registers."""
+    # lint: disable=device-path-host-sync -- GF(2) register algebra on (n,) uint32 CRCs, not batch payload
     v = np.asarray(v, np.uint32)
     bits = ((v[..., None] >> np.arange(32, dtype=np.uint32)) & 1) != 0
     return np.bitwise_xor.reduce(
@@ -190,7 +191,9 @@ def crc32c_strip_zeros(crcs, nzeros):
     computed at the padded lane width.  ``nzeros`` is a scalar or an
     array broadcastable to ``crcs``.
     """
+    # lint: disable=device-path-host-sync -- GF(2) register algebra on (n,) uint32 CRCs, not batch payload
     crcs = np.asarray(crcs, np.uint32)
+    # lint: disable=device-path-host-sync -- GF(2) register algebra on (n,) uint32 CRCs, not batch payload
     z = np.broadcast_to(np.asarray(nzeros, np.int64), crcs.shape)
     out = crcs.copy()
     maxz = int(z.max()) if z.size else 0
@@ -209,6 +212,7 @@ def fold_chunk_crcs(chunk_crcs, chunk_len: int):
     from their individual CRCs (default seed each): the host-side fold
     that turns a launch's per-stripe chunk CRCs into whole-shard CRCs
     without re-reading the bytes."""
+    # lint: disable=device-path-host-sync -- host-side fold of per-chunk uint32 CRCs, not batch payload
     cc = np.asarray(chunk_crcs, np.uint32)
     if cc.shape[0] == 0:
         return np.full(cc.shape[1:], SEED, np.uint32)
@@ -286,8 +290,10 @@ def _crc_rows_numpy(arr: np.ndarray, lengths: np.ndarray,
         mat = _zeros_matrix(width)
         crcs = _mat_apply(mat, crcs[:, 0::2]) ^ crcs[:, 1::2]
         width *= 2
-    return crc32c_strip_zeros(crcs[:, 0],
-                              lp - np.asarray(lengths, np.int64))
+    return crc32c_strip_zeros(
+        crcs[:, 0],
+        # lint: disable=device-path-host-sync -- (n,) length vector for the un-pad, not batch payload
+        lp - np.asarray(lengths, np.int64))
 
 
 def crc32c_numpy_one(data, crc: int = SEED) -> int:
@@ -315,6 +321,7 @@ def crc32c_rows(arr, lengths=None, seed: int = SEED,
     assert arr.ndim == 2, arr.shape
     n, l = arr.shape
     lens = (np.full(n, l, np.int64) if lengths is None
+            # lint: disable=device-path-host-sync -- (n,) length vector of a host-engine call, not batch payload
             else np.asarray(lengths, np.int64))
     PERF.inc("batched_calls")
     PERF.inc("batched_bufs", n)

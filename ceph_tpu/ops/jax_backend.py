@@ -32,6 +32,8 @@ class JaxBackend:
         parity = self.matmul_batch(matrix, data, out_np=False)
         crc_d = crc32c_device_chunks(data)
         crc_p = crc32c_device_chunks(parity)
+        # lint: disable=device-path-host-sync -- the single post-launch materialization of the fused launch
         return (np.asarray(parity),
+                # lint: disable=device-path-host-sync -- the single post-launch materialization of the fused launch
                 np.concatenate([np.asarray(crc_d), np.asarray(crc_p)],
                                axis=1))

@@ -847,10 +847,11 @@ class ECBackend(PGBackend):
         for lo, hi, data_shards in await asyncio.gather(
                 *(_fetch_run(lo, hi) for lo, hi in self._runs(misses))):
             for i, s in enumerate(range(lo, hi + 1)):
-                parts = [data_shards[p][i * cs:(i + 1) * cs]
-                         for p in dpos]
-                out[s] = bytearray(b"".join(
-                    np.asarray(p).tobytes() for p in parts))
+                # one concatenate+tobytes per stripe, not one
+                # asarray+tobytes hop per data chunk
+                out[s] = bytearray(np.concatenate(
+                    [data_shards[p][i * cs:(i + 1) * cs]
+                     for p in dpos]).tobytes())
         return out
 
     async def _submit_partial(self, entry, content_muts: list[dict],

@@ -210,7 +210,9 @@ class CodecBatcher:
     def _launch_one(self, kind: str, codec, extra: tuple,
                     arr: np.ndarray):
         if kind == "encode":
+            # lint: disable=device-path-host-sync -- the single post-launch materialization (out_np=True: already host)
             return np.asarray(codec.encode_batch(arr, out_np=True))
+        # lint: disable=device-path-host-sync -- the single post-launch materialization (out_np=True: already host)
         return np.asarray(codec.decode_batch(list(extra), arr,
                                              out_np=True))
 
@@ -254,6 +256,7 @@ class CodecBatcher:
                     and hasattr(grp.codec, "encode_batch_crc") \
                     and self._fused_crc_ok():
                 out, crcs = grp.codec.encode_batch_crc(batch)
+                # lint: disable=device-path-host-sync -- the single post-launch materialization of the fused launch
                 out = np.asarray(out)
                 if self.perf is not None:
                     self.perf.inc("crc_fused_launches")

@@ -1,0 +1,16 @@
+"""BAD: a counter incremented only in a function nothing reaches.
+
+`_record_drop` is private, never called and never referenced -- the
+`drops` counter charts as eternally zero.
+"""
+
+
+class Daemon:
+    def __init__(self, perf):
+        self.perf = perf
+
+    def handle(self, msg):
+        return msg
+
+    def _record_drop(self):
+        self.perf.inc("drops")

@@ -1,0 +1,26 @@
+"""GOOD: every counter touch has a live path -- a direct call from a
+public entry point, a handler-table reference, and a dynamic
+getattr-by-prefix dispatch."""
+
+
+class Daemon:
+    def __init__(self, perf):
+        self.perf = perf
+        self._table = {"drop": self._record_drop}
+
+    def handle(self, msg):
+        handler = getattr(self, f"_h_{msg.type}", None)
+        if handler is not None:
+            return handler(msg)
+        self._count_op()
+        return None
+
+    def _count_op(self):
+        self.perf.inc("ops")
+
+    def _record_drop(self):
+        self.perf.inc("drops")
+
+    def _h_ping(self, msg):
+        self.perf.inc("pings")
+        return msg

@@ -1,0 +1,37 @@
+"""BAD: decoder field order diverges from the encoder's.
+
+`PinnedMap.denc` writes (u32 epoch, u64 size); `dedenc` reads them
+transposed -- fixed-width reads misalign silently.  `TailMap`'s
+decoder stops early, leaving an encoded tail nothing consumes.
+"""
+
+from ceph_tpu.common import denc  # noqa: F401
+
+
+class PinnedMap:
+    def denc(self, enc):
+        enc.start(1)
+        enc.u32(self.epoch)
+        enc.u64(self.size)
+        enc.finish()
+
+    @classmethod
+    def dedenc(cls, dec):
+        dec.start(1)
+        obj = cls()
+        obj.epoch = dec.u64()
+        obj.size = dec.u32()
+        dec.finish()
+        return obj
+
+
+class TailMap:
+    def denc(self, enc):
+        enc.u32(self.epoch)
+        enc.string(self.name)
+
+    @classmethod
+    def dedenc(cls, dec):
+        obj = cls()
+        obj.epoch = dec.u32()
+        return obj

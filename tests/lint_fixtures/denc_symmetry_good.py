@@ -1,0 +1,37 @@
+"""GOOD: every decoder consumes exactly the encoded field sequence,
+including structured ops across the enc/dec calling-convention
+asymmetry and a module-level _enc_/_dec_ pair."""
+
+from ceph_tpu.common import denc  # noqa: F401
+
+
+class GoodMap:
+    def denc(self, enc):
+        enc.start(1)
+        enc.u32(self.epoch)
+        enc.list(self.items, enc.u64)
+        enc.optional(self.tag, enc.string)
+        enc.map(self.weights, enc.u32, enc.f64)
+        enc.finish()
+
+    @classmethod
+    def dedenc(cls, dec):
+        dec.start(1)
+        obj = cls()
+        obj.epoch = dec.u32()
+        obj.items = dec.list(dec.u64)
+        obj.tag = dec.optional(dec.string)
+        obj.weights = dec.map(dec.u32, dec.f64)
+        dec.finish()
+        return obj
+
+
+def _enc_entry(enc, entry):
+    enc.u32(entry.osd)
+    enc.blob(entry.payload)
+
+
+def _dec_entry(dec):
+    osd = dec.u32()
+    payload = dec.blob()
+    return osd, payload

@@ -1,0 +1,19 @@
+"""BAD: a host sync two calls deep inside the batched launch path.
+
+`CodecBatcher.encode` is a launch entry point; the `np.asarray`
+lives in a helper its helper calls, so only the interprocedural
+closure can see it.
+"""
+
+import numpy as np
+
+
+class CodecBatcher:
+    def encode(self, codec, arr):
+        return self._run(codec, arr)
+
+    def _run(self, codec, arr):
+        return self._materialize(codec.encode_batch(arr))
+
+    def _materialize(self, out):
+        return np.asarray(out)

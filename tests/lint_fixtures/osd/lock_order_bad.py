@@ -1,0 +1,30 @@
+"""BAD: two call chains acquire the same locks in opposite orders.
+
+Each region looks innocent in isolation -- the second acquisition
+lives in a different function, so only the call-graph projection can
+close the cycle.
+"""
+
+import asyncio
+
+
+class PGRegistry:
+    def __init__(self):
+        self._map_lock = asyncio.Lock()
+        self._queue_lock = asyncio.Lock()
+
+    async def publish(self):
+        async with self._map_lock:
+            await self._drain_queue()
+
+    async def _drain_queue(self):
+        async with self._queue_lock:
+            pass
+
+    async def enqueue(self):
+        async with self._queue_lock:
+            await self._read_map()
+
+    async def _read_map(self):
+        async with self._map_lock:
+            pass

@@ -1,0 +1,32 @@
+"""GOOD: one global order (map before queue) on every chain, and a
+lock taken on a *spawned* task does not count as taken under the
+spawner's lock."""
+
+import asyncio
+
+
+class PGRegistry:
+    def __init__(self):
+        self._map_lock = asyncio.Lock()
+        self._queue_lock = asyncio.Lock()
+
+    async def publish(self):
+        async with self._map_lock:
+            await self._drain_queue()
+
+    async def _drain_queue(self):
+        async with self._queue_lock:
+            pass
+
+    async def snapshot(self):
+        async with self._map_lock:
+            async with self._queue_lock:
+                pass
+
+    async def background_read(self):
+        async with self._queue_lock:
+            self._reader = asyncio.ensure_future(self._read_map())
+
+    async def _read_map(self):
+        async with self._map_lock:
+            pass

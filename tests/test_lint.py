@@ -39,6 +39,14 @@ RULE_FIXTURES = {
                        "perf_coherence_good.py"),
     "blocking-under-lock": ("osd/blocking_under_lock_bad.py",
                             "osd/blocking_under_lock_good.py"),
+    "device-path-host-sync": ("device_path_bad.py",
+                              "device_path_good.py"),
+    "denc-symmetry": ("denc_symmetry_bad.py",
+                      "denc_symmetry_good.py"),
+    "lock-order": ("osd/lock_order_bad.py",
+                   "osd/lock_order_good.py"),
+    "counter-coverage": ("counter_coverage_bad.py",
+                         "counter_coverage_good.py"),
 }
 
 
@@ -85,6 +93,64 @@ def test_bad_fixtures_do_not_cross_fire():
         kept, _, _ = lint([bad], FIXTURES)
         assert kept and {f.rule for f in kept} == {rule}, (
             rule, [f.render() for f in kept])
+
+
+# -- interprocedural acceptance pins ----------------------------------------
+
+def test_device_path_injection_two_calls_deep(tmp_path):
+    """A host sync injected two calls deep (and one module away) from
+    a launch entry point is found -- the static closure reaches where
+    the per-module framework could not."""
+    _write(tmp_path, "launch.py",
+           "import numpy as np\n"
+           "from helpers import stage1\n\n\n"
+           "class CodecBatcher:\n"
+           "    def encode(self, codec, arr):\n"
+           "        return stage1(codec, np.ascontiguousarray(arr))\n")
+    _write(tmp_path, "helpers.py",
+           "import numpy as np\n\n\n"
+           "def stage1(codec, arr):\n"
+           "    return _stage2(codec.encode_batch(arr))\n\n\n"
+           "def _stage2(out):\n"
+           "    return np.asarray(out)\n")
+    kept, _, _ = lint(["launch.py", "helpers.py"], str(tmp_path),
+                      rules=["device-path-host-sync"])
+    assert len(kept) == 1, [f.render() for f in kept]
+    f = kept[0]
+    assert f.path == "helpers.py"
+    assert "CodecBatcher.encode" in f.message
+
+
+def test_device_path_roots_cover_the_dynamic_gate():
+    """Every launch entry point the scalar_calls_on_batched_paths
+    bench gate drives resolves to a real function, so the static rule
+    anchors at (at least) the paths the dynamic gate watches."""
+    from ceph_tpu.analysis.checkers.device_path import ROOTS
+    _, project = analysis.run(TREE_PATHS, REPO,
+                              rules=["device-path-host-sync"])
+    graph = project.graph()
+    missing = [spec for spec in ROOTS if not graph.lookup(spec)]
+    assert missing == [], missing
+
+
+LINT_BUDGET_SECONDS = 30.0
+
+
+def test_full_tree_lint_within_time_budget():
+    """The whole-tree run -- parse, call graph, every rule -- must
+    stay affordable or the pre-commit gate rots.  The budget is ~5x
+    the current cost; a regression past it means something went
+    accidentally quadratic."""
+    import time
+    t0 = time.perf_counter()
+    profile = {}
+    analysis.run(TREE_PATHS, REPO, profile=profile)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < LINT_BUDGET_SECONDS, (
+        f"full-tree lint took {elapsed:.1f}s "
+        f"(budget {LINT_BUDGET_SECONDS}s); slowest rules: "
+        f"{sorted(profile.items(), key=lambda kv: -kv[1])[:5]}")
+    assert "[parse]" in profile and "[callgraph]" in profile
 
 
 # -- suppression round-trips ------------------------------------------------
@@ -190,8 +256,17 @@ def test_cli_nonzero_on_findings_and_rule_filter():
 
 
 def test_cli_changed_mode_runs():
-    """--changed lints only git-dirty files inside the default scope
-    (never the fixture corpus), so it exits clean on a clean tree and
-    on a tree whose dirty files pass the rules."""
+    """--changed lints the git-dirty files plus their reverse-
+    reachable callers (never the fixture corpus), so it exits clean
+    on a clean tree and on a tree whose dirty closure passes."""
     res = _cli("--changed")
     assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_profile_reports_per_rule_times():
+    res = _cli("--profile", "ceph_tpu/analysis")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "[parse]" in res.stderr
+    assert "[callgraph]" in res.stderr
+    assert "[total]" in res.stderr
+    assert "device-path-host-sync" in res.stderr

@@ -4,16 +4,24 @@
 
     python tools/lint.py                      # lint the default tree
     python tools/lint.py ceph_tpu/osd         # lint a subtree
-    python tools/lint.py --changed            # only git-dirty files
+    python tools/lint.py --changed            # dirty files + callers
+    python tools/lint.py --profile            # per-rule wall time
     python tools/lint.py --list-rules
     python tools/lint.py --rules hole-sentinel,x64-scope ceph_tpu
     python tools/lint.py --write-baseline     # accept current findings
 
 Findings print as ``path:line rule message``; exit status is non-zero
 when any unsuppressed, unbaselined finding remains.  Suppress a single
-site with a trailing ``# lint: disable=<rule>`` comment; park legacy
-findings in ``tools/lint_baseline.txt`` (kept empty -- the tree is
-clean -- but the mechanism is how a new rule lands without blocking).
+site with a trailing ``# lint: disable=<rule> -- why`` comment; park
+legacy findings in ``tools/lint_baseline.txt`` (kept empty -- the tree
+is clean -- but the mechanism is how a new rule lands without
+blocking).
+
+``--changed`` parses the WHOLE default tree (the interprocedural
+rules need the full call graph either way) but reports findings only
+for the git-dirty files plus every module holding a transitive caller
+of anything they define -- an edit to a callee can surface
+whole-program findings in callers that did not change.
 """
 
 from __future__ import annotations
@@ -68,8 +76,10 @@ def main(argv: list[str] | None = None) -> int:
                     help=f"files/dirs to lint (default: "
                          f"{' '.join(DEFAULT_PATHS)})")
     ap.add_argument("--changed", action="store_true",
-                    help="lint only files git considers modified "
-                         "(fast pre-commit mode)")
+                    help="report only git-dirty files plus their "
+                         "reverse-reachable callers (pre-commit mode)")
+    ap.add_argument("--profile", action="store_true",
+                    help="print per-rule wall time to stderr")
     ap.add_argument("--rules",
                     help="comma-separated subset of rules to run")
     ap.add_argument("--list-rules", action="store_true",
@@ -90,20 +100,43 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     rules = (args.rules.split(",") if args.rules else None)
+    dirty: list[str] = []
     if args.changed:
-        paths = changed_files(REPO_ROOT)
-        if not paths:
+        dirty = changed_files(REPO_ROOT)
+        if not dirty:
             print("lint: no changed python files", file=sys.stderr)
             return 0
+        # the interprocedural rules need the whole program: parse the
+        # full default tree, then narrow the REPORT to dirty+callers
+        paths = DEFAULT_PATHS
     else:
         paths = args.paths or DEFAULT_PATHS
 
+    profile: dict[str, float] | None = ({} if args.profile else None)
     try:
         findings, project = analysis.run(paths, root=REPO_ROOT,
-                                         rules=rules)
+                                         rules=rules, profile=profile)
     except KeyError as e:                   # unknown --rules entry
         print(f"lint: {e.args[0]}", file=sys.stderr)
         return 2
+
+    if args.changed:
+        closure = analysis.changed_closure(project, dirty)
+        expanded = sorted(closure - set(dirty))
+        if expanded:
+            print(f"lint: --changed expanded {len(dirty)} dirty "
+                  f"file(s) with {len(expanded)} caller file(s)",
+                  file=sys.stderr)
+        findings = [f for f in findings if f.path in closure]
+
+    if profile is not None:
+        total = sum(profile.values())
+        for name, secs in sorted(profile.items(),
+                                 key=lambda kv: -kv[1]):
+            print(f"lint: profile {name:24s} {secs * 1e3:9.1f} ms",
+                  file=sys.stderr)
+        print(f"lint: profile {'[total]':24s} {total * 1e3:9.1f} ms",
+              file=sys.stderr)
 
     baseline = (set() if args.no_baseline or args.write_baseline
                 else analysis.load_baseline(args.baseline))

@@ -389,7 +389,14 @@ def _stale_candidates() -> list[tuple[str, str | None]]:
 def _emit_stale(reason: str) -> bool:
     """Fall back to the most recent committed hardware result, marked
     ``stale`` with its capture provenance.  Returns False if none
-    exists (then the caller emits the honest 0.0)."""
+    exists (then the caller emits the honest 0.0).
+
+    Provenance is MANDATORY: the artifact carries ``"stale": true`` +
+    ``"source_round"`` (the parsed round number the bytes were
+    actually captured in; -1 for the uncommitted interim file) and a
+    WARNING is printed -- the MULTICHIP_r05-was-a-copy-of-r02 trap,
+    where a last-known-good fallback masqueraded as a fresh round,
+    cannot recur silently."""
     candidates = _stale_candidates()
     for path, key in candidates:
         try:
@@ -406,9 +413,13 @@ def _emit_stale(reason: str) -> bool:
         RESULT["stale"] = True
         RESULT["stale_reason"] = reason
         RESULT["stale_source"] = os.path.basename(path)
+        RESULT["source_round"] = _bench_round_no(path)
         if key is None and "captured_at" in j:
             RESULT["captured_at"] = j["captured_at"]
-        log(f"STALE fallback: {path} (value {RESULT['value']})")
+        log(f"WARNING: STALE fallback -- this artifact is a COPY of "
+            f"{os.path.basename(path)} (source_round "
+            f"{RESULT['source_round']}, value {RESULT['value']}), "
+            f"NOT a fresh capture ({reason})")
         emit()
         return True
     return False
@@ -888,37 +899,170 @@ def _cluster_mode(deadline: float, smoke: bool) -> int:
     return rc
 
 
-def _osd_path_mode(deadline: float) -> int:
+def _mesh_gates(smoke: bool) -> dict:
+    """The --mesh acceptance gates, run before the cluster drive:
+
+    * PARITY: sharded-mesh encode/decode/RMW (+ fused chunk CRCs)
+      byte-identical to the single-device scalar codec oracle,
+      including a ragged-lane co-submission;
+    * LAUNCH ACCOUNTING: a mesh-backed CodecBatcher runs EXACTLY ONE
+      device launch per coalesced batch (mesh_launches == batches,
+      zero mesh_fallbacks) -- the CRC side-path rides inside it;
+    * ``scalar_calls_on_batched_paths == 0``: the drive makes no
+      scalar ``native.crc32c`` call.
+
+    Raises on parity failure; returns the gate report dict."""
+    import asyncio
+    import numpy as np
+    from ceph_tpu import native
+    from ceph_tpu.common.perf import PerfCounters
+    from ceph_tpu.ec import registry
+    from ceph_tpu.ops.crc32c_batch import PERF
+    from ceph_tpu.osd.codec_batcher import CodecBatcher
+    from ceph_tpu.parallel.mesh_codec import MeshCodec
+
+    rng = np.random.default_rng(12)
+    codec = registry().factory("tpu", {"k": "4", "m": "2",
+                                       "technique": "reed_sol_van"})
+    mesh = MeshCodec()
+    n, lane = (16, 256) if smoke else (64, 4096)
+    log(f"mesh gates: {mesh.n_devices} devices, "
+        f"{n} stripes x {lane} B chunks")
+
+    data = rng.integers(0, 256, (n, 4, lane), dtype=np.uint8)
+    parity, crcs = mesh.encode(codec, data, with_crc=True)
+    full = np.concatenate([data, parity], axis=1)
+    for s in range(0, n, max(1, n // 8)):
+        want = codec.encode(set(range(6)), data[s].tobytes())
+        for r in range(2):
+            if not np.array_equal(parity[s, r], want[4 + r]):
+                raise RuntimeError(f"mesh encode parity failure @{s}")
+        for c in range(6):
+            if int(crcs[s, c]) != native.crc32c(full[s, c].tobytes()):
+                raise RuntimeError(f"mesh fused-CRC failure @{s},{c}")
+    erasures = [1, 4]
+    didx = [i for i in range(6) if i not in erasures][:4]
+    rec = mesh.decode(codec, erasures, full[:, didx])
+    for s in range(0, n, max(1, n // 8)):
+        for p, e in enumerate(erasures):
+            if not np.array_equal(rec[s, p], full[s, e]):
+                raise RuntimeError(f"mesh decode parity failure @{s}")
+    delta = np.zeros_like(data)
+    delta[:, 2, : lane // 4] = rng.integers(
+        0, 256, (n, lane // 4), dtype=np.uint8)
+    newdata = data ^ delta
+    if not np.array_equal(mesh.rmw(codec, parity, delta),
+                          mesh.encode(codec, newdata)):
+        raise RuntimeError("mesh RMW delta parity failure")
+    log("mesh parity gate passed (encode+crc, decode, rmw)")
+
+    perf = PerfCounters("ec_batch")
+    batcher = CodecBatcher(max_batch=8, flush_timeout=0.2, perf=perf)
+    a1 = rng.integers(0, 256, (3, 4, lane), dtype=np.uint8)
+    a2 = rng.integers(0, 256, (2, 4, lane // 2), dtype=np.uint8)
+
+    async def drive():
+        enc = asyncio.gather(batcher.encode(codec, a1, with_crc=True),
+                             batcher.encode(codec, a2, with_crc=True))
+        (p1, c1), (p2, c2) = await enc
+        dec = await batcher.decode(
+            codec, tuple(erasures),
+            np.concatenate([a1, p1], axis=1)[:, didx])
+        return (p1, c1), (p2, c2), dec
+
+    scalar0 = PERF.get("scalar_calls")
+    (p1, c1), (p2, c2), dec = asyncio.new_event_loop() \
+        .run_until_complete(drive())
+    scalar_delta = PERF.get("scalar_calls") - scalar0
+    for arr, par, cc in ((a1, p1, c1), (a2, p2, c2)):
+        fl = np.concatenate([arr, par], axis=1)
+        for s in range(arr.shape[0]):
+            want = codec.encode(set(range(6)), arr[s].tobytes())
+            for r in range(2):
+                assert np.array_equal(par[s, r], want[4 + r]), s
+            for c in range(6):
+                assert int(cc[s, c]) == native.crc32c(
+                    fl[s, c].tobytes()), (s, c)
+    batches = perf.get("batches")
+    launches = perf.get("mesh_launches")
+    lpb = launches / batches if batches else 0.0
+    padded = perf.get("mesh_padded_stripes")
+    gates = {
+        "n_devices": mesh.n_devices,
+        "launches_per_batch": round(lpb, 3),
+        "per_device_stripes": round(
+            padded / launches / mesh.n_devices, 2) if launches else 0.0,
+        "mesh_fallbacks": perf.get("mesh_fallbacks"),
+        "scalar_calls_on_batched_paths": scalar_delta,
+        "parity": "ok",
+    }
+    log(f"mesh launch gate: {launches} launches / {batches} batches "
+        f"(= {lpb:.2f}), fallbacks={gates['mesh_fallbacks']}, "
+        f"scalar_calls_delta={scalar_delta}")
+    return gates
+
+
+def _osd_path_mode(deadline: float, mesh: bool = False,
+                   smoke: bool = False) -> int:
     """--osd-path: drive the OSD DATA PATH — concurrent client EC
     writes through an in-process mon+OSD cluster — instead of the raw
     codec, so the artifact reports what the system achieves (including
     the CodecBatcher's achieved stripes-per-launch), not just what the
-    kernel could do."""
+    kernel could do.  --mesh adds the sharded-data-plane gates (mesh
+    parity vs the scalar oracle, exactly one device launch per
+    coalesced batch, scalar_calls_on_batched_paths=0) and reports the
+    mesh occupancy the cluster actually achieved; --smoke keeps the
+    workload tier-1 sized and exits non-zero on any gate failure."""
     import asyncio
     from ceph_tpu.tools.ec_osd_bench import run_osd_path_bench
 
-    log("osd-path mode: in-process cluster, concurrent EC writes")
+    gates = _mesh_gates(smoke) if mesh else None
+    log(f"osd-path mode: in-process cluster, concurrent EC writes"
+        f" (mesh={mesh}, smoke={smoke})")
     res = asyncio.run(run_osd_path_bench(
         n_osds=int(os.environ.get("BENCH_OSD_N", "3")),
         k=int(os.environ.get("BENCH_OSD_K", "2")),
         m=int(os.environ.get("BENCH_OSD_M", "1")),
-        n_objects=int(os.environ.get("BENCH_OSD_OBJECTS", "48")),
-        obj_bytes=int(os.environ.get("BENCH_OSD_OBJ_KIB", "64")) * 1024,
-        concurrency=int(os.environ.get("BENCH_OSD_CONCURRENCY", "16")),
+        n_objects=int(os.environ.get("BENCH_OSD_OBJECTS",
+                                     "12" if smoke else "48")),
+        obj_bytes=int(os.environ.get(
+            "BENCH_OSD_OBJ_KIB", "16" if smoke else "64")) * 1024,
+        concurrency=int(os.environ.get("BENCH_OSD_CONCURRENCY",
+                                       "8" if smoke else "16")),
         batch_max=int(os.environ.get("BENCH_OSD_BATCH", "64")),
+        mesh=mesh or None,
     ))
     log(f"osd path: {res['osd_path_GiBps']} GiB/s, "
         f"{res['stripes_per_launch']} stripes/launch "
         f"({res['batches']} launches)")
+    if gates is not None:
+        gates["cluster_launches_per_batch"] = \
+            res.get("mesh", {}).get("launches_per_batch", 0.0)
+        res["mesh_gates"] = gates
     RESULT.update({
         "metric": "ec_osd_path_write_GiBps",
         "value": res["osd_path_GiBps"],
         "unit": "GiB/s",
         "vs_baseline": 0.0,
+        "smoke": smoke,
         **res,
     })
     emit()
-    return 0
+    if gates is None:
+        return 0
+    rc = 0
+    if gates["launches_per_batch"] != 1.0 or gates["mesh_fallbacks"]:
+        log("ERROR: mesh gate demands exactly one device launch per "
+            "coalesced batch")
+        rc = 1
+    if gates["scalar_calls_on_batched_paths"] != 0:
+        log("ERROR: scalar CRC calls observed on the mesh path")
+        rc = 1
+    cluster = res.get("mesh", {})
+    if cluster.get("launches", 0) == 0 or cluster.get("fallbacks", 0):
+        log("ERROR: the cluster drive did not ride the mesh")
+        rc = 1
+    return rc
 
 
 def main() -> int:
@@ -931,7 +1075,11 @@ def main() -> int:
     global _ALLOW_STALE
     if "--osd-path" in sys.argv[1:] or os.environ.get("BENCH_OSD_PATH"):
         _ALLOW_STALE = False
-        return _osd_path_mode(deadline)
+        return _osd_path_mode(
+            deadline,
+            mesh=("--mesh" in sys.argv[1:]
+                  or bool(os.environ.get("BENCH_OSD_MESH"))),
+            smoke="--smoke" in sys.argv[1:])
     if "--cluster" in sys.argv[1:] or os.environ.get("BENCH_CLUSTER"):
         _ALLOW_STALE = False
         return _cluster_mode(deadline, "--smoke" in sys.argv[1:])

@@ -6,6 +6,7 @@ from . import (        # noqa: F401
     counter_coverage,
     denc_symmetry,
     device_path,
+    donated_aliasing,
     dropped_task,
     hole_sentinel,
     jit_stability,

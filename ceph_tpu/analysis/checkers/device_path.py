@@ -48,6 +48,9 @@ from ..registry import ProjectChecker, register
 ROOTS = (
     "CodecBatcher.encode",
     "CodecBatcher.decode",
+    "MeshCodec.encode",
+    "MeshCodec.decode",
+    "MeshCodec.rmw",
     "StripeInfo.encode_async",
     "StripeInfo.decode_async",
     "StripeInfo.reconstruct_logical_async",

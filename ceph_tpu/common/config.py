@@ -128,6 +128,15 @@ DEFAULT_SCHEMA: list[Option] = [
            min=0.0),
     Option("osd_ec_batch_eager_flush", OPT_BOOL, True,
            "flush the codec batch when the event loop goes idle"),
+    Option("osd_ec_mesh_enabled", OPT_BOOL, True,
+           "launch coalesced EC batches through the sharded device "
+           "mesh (stripe axis partitioned over all visible chips; "
+           "single-device is a 1-device mesh on the same code path)"),
+    Option("osd_ec_mesh_devices", OPT_INT, 0,
+           "devices in the codec mesh (0 = all visible)", min=0),
+    Option("osd_ec_mesh_donate", OPT_BOOL, True,
+           "donate stripe buffers to mesh launches (consume the "
+           "device copy in place instead of defensive-copying it)"),
     Option("osd_heartbeat_max_peers", OPT_INT, 10,
            "heartbeat fanout cap: PG peers + id-ring neighbors "
            "instead of the O(N^2) full mesh (0 = uncapped)", min=0),

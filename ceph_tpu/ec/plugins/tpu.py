@@ -53,6 +53,14 @@ class ErasureCodeTpu(ErasureCodeIsa):
         ``chunks`` is (B, k, L): for every stripe, the k surviving chunks in
         decode_index order (first k surviving shard ids ascending).
         """
+        matrix = self.decode_matrix_for(erasures)
+        return self.backend.matmul_batch(matrix, chunks, out_np=out_np)
+
+    def decode_matrix_for(self, erasures) -> np.ndarray:
+        """The decode matrix an erasure pattern selects, through the
+        DecodeTableCache.  Shared by ``decode_batch`` and the sharded
+        MeshCodec decode path, so both launch engines compute with the
+        identical matrix (byte parity by construction)."""
         from ...gf import build_decode_matrix
         signature = self.decode_signature(erasures)
         entry = self.tcache.get(signature)
@@ -62,7 +70,7 @@ class ErasureCodeTpu(ErasureCodeIsa):
             self.tcache.put(signature, matrix, decode_index)
         else:
             matrix, decode_index = entry
-        return self.backend.matmul_batch(matrix, chunks, out_np=out_np)
+        return matrix
 
 
 def _factory(profile):

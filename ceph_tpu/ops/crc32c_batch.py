@@ -414,22 +414,20 @@ def fused_enabled() -> bool:
     return not os.environ.get("CEPH_TPU_NO_FUSED_CRC")
 
 
-@functools.lru_cache(maxsize=1)
-def _tables_device():
-    import jax.numpy as jnp
-    return jnp.asarray(_tables())
-
-
 @functools.lru_cache(maxsize=64)
 def _crc_chunks_compiled(l: int):
     """Jitted (N, l) uint8 -> (N,) uint32 chunk CRCs (default seed),
     slice-by-8 fori_loop over the lane axis."""
     import jax
     import jax.numpy as jnp
-    t = _tables_device()
+    # host constant staged per trace: device-caching the tables here
+    # would capture a tracer when the first call happens inside an
+    # outer trace (the MeshCodec fused launch) and poison the cache
+    tnp = _tables()
     n8 = l // 8
 
     def fn(x):
+        t = jnp.asarray(tnp)
         crc = jnp.full((x.shape[0],), SEED, jnp.uint32)
         xu = x.astype(jnp.uint32)
 
@@ -453,18 +451,28 @@ def _crc_chunks_compiled(l: int):
     return jax.jit(fn)
 
 
-def crc32c_device_chunks(x):
-    """(..., L) uint8 (host or device array) -> (...,) uint32 chunk
-    CRCs computed on the accelerator.  Returns a DEVICE array so the
-    caller fetches it together with the parity of the same launch
-    window -- the fused path of the codec batcher."""
+def crc32c_chunks_traced(x):
+    """Trace-safe core of ``crc32c_device_chunks``: same math, no perf
+    side effects, safe to INLINE inside a larger jitted program -- the
+    MeshCodec fused path calls this so the chunk CRCs are part of the
+    one sharded launch that produces the parity (the CRC math is
+    row-independent, so GSPMD partitions it over the stripe axis with
+    no collective)."""
     import jax.numpy as jnp
     xd = jnp.asarray(x, jnp.uint8)
     lead, l = xd.shape[:-1], xd.shape[-1]
     if l == 0:                      # zero-length chunks: seed, no kernel
         return jnp.full(lead, SEED, jnp.uint32)
     flat = xd.reshape((-1, l))
-    out = _crc_chunks_compiled(l)(flat)
+    return _crc_chunks_compiled(l)(flat).reshape(lead)
+
+
+def crc32c_device_chunks(x):
+    """(..., L) uint8 (host or device array) -> (...,) uint32 chunk
+    CRCs computed on the accelerator.  Returns a DEVICE array so the
+    caller fetches it together with the parity of the same launch
+    window -- the fused path of the codec batcher."""
+    out = crc32c_chunks_traced(x)
     PERF.inc("fused_launches")
-    PERF.inc("fused_crcs", int(flat.shape[0]))
-    return out.reshape(lead)
+    PERF.inc("fused_crcs", int(np.prod(out.shape, dtype=np.int64)))
+    return out

@@ -353,7 +353,12 @@ class ECBackend(PGBackend):
     Codec launches go through the per-OSD CodecBatcher
     (osd.codec_batcher): all stripes of an op share one
     encode_batch/decode_batch launch, and concurrent ops across PGs
-    coalesce into common launches.
+    coalesce into common launches.  The batcher in turn launches
+    coalesced batches through the sharded device mesh
+    (parallel/mesh_codec.MeshCodec) when one is configured, so
+    full-stripe writes, degraded-read decodes and recovery
+    reconstructions all ride the multichip data plane transparently
+    -- on a single device that is a 1-device mesh, same code path.
     """
 
     def __init__(self, pg) -> None:
@@ -374,6 +379,12 @@ class ECBackend(PGBackend):
         perf = getattr(self.osd, "perf", None)
         self.perf_degraded = perf.create("ec_degraded") \
             if perf is not None else None
+        # hot-path config SNAPSHOT (the ROADMAP config-reads-on-hot-
+        # paths item): _gather_shards runs per degraded read; looking
+        # these up per call put a dict probe chain on the read path
+        self._read_retries = self._cfg("osd_ec_read_retries", 3)
+        self._read_timeout = self._cfg("osd_ec_read_timeout", 5.0)
+        self._read_backoff = self._cfg("osd_ec_read_backoff", 0.25)
 
     def _count(self, key: str, by: int = 1) -> None:
         if self.perf_degraded is not None:
@@ -568,9 +579,9 @@ class ECBackend(PGBackend):
                    or self.sinfo.data_positions(self.codec))
         if not want <= set(avail):
             self._count("degraded_reads")    # a decode must reconstruct
-        retries = self._cfg("osd_ec_read_retries", 3)
-        timeout = self._cfg("osd_ec_read_timeout", 5.0)
-        backoff = self._cfg("osd_ec_read_backoff", 0.25)
+        retries = self._read_retries
+        timeout = self._read_timeout
+        backoff = self._read_backoff
         fetched: dict[int, tuple[np.ndarray, int, tuple]] = {}
         rejected: set[int] = set()
         # bounded: staleness can reject at most len(acting) shards and

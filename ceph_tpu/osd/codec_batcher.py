@@ -35,7 +35,14 @@ Mechanics:
     short timer backstop;
   * codecs without batch entry points (isa/jerasure host plugins,
     layered codes with chunk remapping) fall back transparently to the
-    per-op path -- ``supports`` gates at the call site.
+    per-op path -- ``supports`` gates at the call site;
+  * coalesced batches of mesh-capable codecs launch through the
+    sharded data plane (parallel/mesh_codec.MeshCodec): ONE
+    shard_map-compiled launch partitions the stripe-batch axis over
+    every visible device with donated stripe buffers and the CRC
+    side-path fused into the same program -- a single device is just
+    a 1-device mesh, so the code path is identical from laptop CPU to
+    a full slice.
 
 Occupancy is surfaced as perf counters (``perf dump`` -> "ec_batch"):
 batches launched, a stripes-per-batch histogram, padding waste, and
@@ -90,15 +97,61 @@ class CodecBatcher:
 
     def __init__(self, *, max_batch: int = 64,
                  flush_timeout: float = 0.002,
-                 eager_flush: bool = True, perf=None) -> None:
+                 eager_flush: bool = True, perf=None,
+                 mesh="auto", mesh_devices: int = 0,
+                 mesh_donate: bool = True) -> None:
         self.max_batch = max(1, int(max_batch))
         self.flush_timeout = float(flush_timeout)
         self.eager_flush = bool(eager_flush)
         self.perf = perf
+        # sharded data plane (parallel/mesh_codec.py): "auto" builds a
+        # MeshCodec over the visible devices LAZILY on the first
+        # mesh-eligible launch (a replicated-only OSD never pays the
+        # jax import), None keeps the single-device codec launches, or
+        # pass a MeshCodec instance directly.  All knobs are SNAPSHOT
+        # here -- no config object is retained and nothing is looked
+        # up per batch (from_config + the test_mesh_codec assertion).
+        self._mesh = mesh if mesh != "auto" else None
+        self._mesh_auto = mesh == "auto"
+        self._mesh_devices = int(mesh_devices)
+        self._mesh_donate = bool(mesh_donate)
         self._groups: dict[tuple, _Group] = {}
         self._closed = False
         if perf is not None:
             perf.hist_register("stripes_per_batch", STRIPE_HIST_BUCKETS)
+
+    @classmethod
+    def from_config(cls, conf, perf=None) -> "CodecBatcher | None":
+        """Construction-time snapshot of every batcher/mesh knob (the
+        hot launch loop must never call ``conf.get``).  Returns None
+        when EC batching is disabled."""
+        if not conf.get("osd_ec_batch_enabled", True):
+            return None
+        return cls(
+            max_batch=int(conf.get("osd_ec_batch_max", 64)),
+            flush_timeout=float(conf.get("osd_ec_batch_timeout",
+                                         0.002)),
+            eager_flush=bool(conf.get("osd_ec_batch_eager_flush",
+                                      True)),
+            mesh=("auto" if conf.get("osd_ec_mesh_enabled", True)
+                  else None),
+            mesh_devices=int(conf.get("osd_ec_mesh_devices", 0)),
+            mesh_donate=bool(conf.get("osd_ec_mesh_donate", True)),
+            perf=perf)
+
+    def _mesh_for(self, codec):
+        """The sharded launch engine for this codec, or None (then the
+        codec's own single-device batch entry points serve)."""
+        if self._mesh is None and not self._mesh_auto:
+            return None
+        from ..parallel.mesh_codec import MeshCodec
+        if not MeshCodec.supports(codec):
+            return None
+        if self._mesh is None:
+            self._mesh = MeshCodec(n_devices=self._mesh_devices,
+                                   donate=self._mesh_donate,
+                                   perf=self.perf)
+        return self._mesh
 
     # -- capability gate ----------------------------------------------------
     @staticmethod
@@ -238,7 +291,9 @@ class CodecBatcher:
         k = items[0][0].shape[1]
         lane = max(a.shape[2] for a, _, _ in items)
         total = sum(a.shape[0] for a, _, _ in items)
-        b = bucket_batch(total)
+        mesh = self._mesh_for(grp.codec)
+        b = mesh.pad_batch(total) if mesh is not None \
+            else bucket_batch(total)
         payload = sum(a.size for a, _, _ in items)
         if len(items) == 1 and b == total:
             batch = items[0][0]
@@ -252,7 +307,35 @@ class CodecBatcher:
         want_crc = any(w for _, _, w in items)
         crcs = None
         try:
-            if want_crc and grp.kind == "encode" \
+            out = None
+            if mesh is not None:
+                # the sharded data plane: ONE launch for the whole
+                # coalesced batch, partitioned over every mesh device,
+                # fused CRCs riding the same launch when wanted.  A
+                # mesh failure degrades to the single-device ladder
+                # below instead of failing every waiter.
+                try:
+                    if grp.kind == "encode" and want_crc \
+                            and self._fused_crc_ok():
+                        out, crcs = mesh.encode(grp.codec, batch,
+                                                with_crc=True)
+                        if self.perf is not None:
+                            self.perf.inc("crc_fused_launches")
+                    elif grp.kind == "encode":
+                        out = mesh.encode(grp.codec, batch)
+                        if want_crc:
+                            crcs = self._host_chunk_crcs(batch, out)
+                            if self.perf is not None:
+                                self.perf.inc("crc_host_batches")
+                    else:
+                        out = mesh.decode(grp.codec, grp.extra, batch)
+                except Exception:
+                    out = crcs = None
+                    if self.perf is not None:
+                        self.perf.inc("mesh_fallbacks")
+            if out is not None:
+                pass
+            elif want_crc and grp.kind == "encode" \
                     and hasattr(grp.codec, "encode_batch_crc") \
                     and self._fused_crc_ok():
                 out, crcs = grp.codec.encode_batch_crc(batch)

@@ -146,17 +146,11 @@ class OSD:
         # OSD funnels encode/decode work through ONE batcher so
         # concurrent ops share accelerator launches
         # (ceph_tpu/osd/codec_batcher.py)
+        # every knob (batching AND the sharded-mesh data plane) is
+        # snapshot here, once: the launch loop never reads config
         from .codec_batcher import CodecBatcher
-        if self.config.get("osd_ec_batch_enabled", True):
-            self.codec_batcher = CodecBatcher(
-                max_batch=int(self.config.get("osd_ec_batch_max", 64)),
-                flush_timeout=float(
-                    self.config.get("osd_ec_batch_timeout", 0.002)),
-                eager_flush=bool(
-                    self.config.get("osd_ec_batch_eager_flush", True)),
-                perf=self.perf.create("ec_batch"))
-        else:
-            self.codec_batcher = None
+        self.codec_batcher = CodecBatcher.from_config(
+            self.config, perf=self.perf.create("ec_batch"))
         self._notify_serial = itertools.count(1)
         self._notify_waiters: dict[str, asyncio.Future] = {}
         # TrackedOp/OpTracker (src/common/TrackedOp.h): in-flight op

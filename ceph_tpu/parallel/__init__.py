@@ -11,9 +11,19 @@ from .sharded_ec import (  # noqa: F401
     lrc_make_mesh,
     lrc_sharded_encode,
     lrc_sharded_local_repair,
+    make_data_mesh,
     make_mesh,
     sharded_cross_recovery,
     sharded_encode,
     sharded_ec_step,
     sharded_rmw,
 )
+
+
+def __getattr__(name):
+    # MeshCodec lazily: importing ceph_tpu.parallel must not force the
+    # jax.sharding stack onto daemons that never take an EC launch
+    if name in ("MeshCodec", "clear_mesh_cache"):
+        from . import mesh_codec
+        return getattr(mesh_codec, name)
+    raise AttributeError(name)

@@ -43,6 +43,23 @@ def make_mesh(n_devices: int | None = None, shard_axis: int = 2) -> Mesh:
     return Mesh(devs.reshape(n // shard, shard), ("stripe", "shard"))
 
 
+def make_data_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D ('stripe',) mesh over the first n visible devices.
+
+    The live OSD data plane (parallel/mesh_codec.py) partitions only
+    the stripe-batch axis: every stripe is independent, so the sharded
+    encode/decode needs ZERO collectives -- each chip computes the
+    parity of its batch slice and a multi-chip slice behaves like one
+    giant codec.  A single device degenerates to a 1-device mesh on
+    the identical code path (how the CPU tier-1 suite exercises it,
+    and why ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+    gives the real 8-way program on a laptop)."""
+    devs = jax.devices()
+    n = min(n_devices or len(devs), len(devs))
+    # lint: disable=device-path-host-sync -- marshals the DEVICE LIST into the Mesh, once at construction; no batch data flows here
+    return Mesh(np.asarray(devs[:n]), ("stripe",))
+
+
 def _gf_matmul_bits(w_i8: jnp.ndarray, data_u8: jnp.ndarray) -> jnp.ndarray:
     """(8r,8k) x (k,N) -> (r,N); same math as ops.gf2kernels."""
     k, n = data_u8.shape

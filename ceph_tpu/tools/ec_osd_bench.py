@@ -32,8 +32,15 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
                              pg_num: int = 8,
                              batch_max: int = 64,
                              batch_timeout: float = 0.002,
-                             rounds: int = 2) -> dict:
-    """Drive N concurrent EC writes; return throughput + occupancy."""
+                             rounds: int = 2,
+                             mesh: bool | None = None) -> dict:
+    """Drive N concurrent EC writes; return throughput + occupancy.
+
+    ``mesh`` forces the sharded data plane on (True) or off (False);
+    None keeps the config default.  With the mesh, the report adds the
+    per-OSD mesh occupancy: device launches per coalesced batch (the
+    exactly-one gate), devices in the mesh, and padded stripes per
+    device per launch (the sharding factor)."""
     import numpy as np
     from ..client.rados import Rados
     from ..mon import Monitor
@@ -44,10 +51,13 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
     mon.peer_addrs = [addr]
     osds = []
     for i in range(n_osds):
-        osd = OSD(host=f"host{i}", config={
+        cfg = {
             "osd_ec_batch_max": batch_max,
             "osd_ec_batch_timeout": batch_timeout,
-        })
+        }
+        if mesh is not None:
+            cfg["osd_ec_mesh_enabled"] = bool(mesh)
+        osd = OSD(host=f"host{i}", config=cfg)
         await osd.start(addr)
         osds.append(osd)
     rados = await Rados(addr).connect()
@@ -83,6 +93,8 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
 
         # roll up batch occupancy over every OSD's aggregation stage
         batches = stripes = pad = fallback = 0
+        mesh_launches = mesh_padded = mesh_fallbacks = 0
+        n_devices = 0
         flush: dict[str, int] = {}
         for osd in osds:
             dump = osd.perf.dump().get("ec_batch", {})
@@ -90,9 +102,26 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
             stripes += dump.get("stripes", 0)
             pad += dump.get("pad_waste_bytes", 0)
             fallback += dump.get("fallback_ops", 0)
+            mesh_launches += dump.get("mesh_launches", 0)
+            mesh_padded += dump.get("mesh_padded_stripes", 0)
+            mesh_fallbacks += dump.get("mesh_fallbacks", 0)
+            n_devices = max(n_devices,
+                            int(dump.get("mesh_devices", 0)))
+        for osd in osds:
+            dump = osd.perf.dump().get("ec_batch", {})
             for key, v in dump.items():
                 if key.startswith("flush_"):
                     flush[key] = flush.get(key, 0) + v
+        mesh_report = {
+            "launches": mesh_launches,
+            "fallbacks": mesh_fallbacks,
+            "launches_per_batch": round(mesh_launches / batches, 3)
+            if batches else 0.0,
+            "n_devices": n_devices,
+            "per_device_stripes": round(
+                mesh_padded / mesh_launches / n_devices, 2)
+            if mesh_launches and n_devices else 0.0,
+        }
         return {
             "osd_path_GiBps": round(total_bytes / dt / 2**30, 3),
             "writes_per_s": round(rounds * n_objects / dt, 1),
@@ -102,6 +131,7 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
             "stripes": stripes,
             "pad_waste_bytes": pad,
             "fallback_ops": fallback,
+            "mesh": mesh_report,
             "flush_reasons": flush,
             "n_osds": n_osds, "k": k, "m": m,
             "objects": n_objects, "obj_bytes": obj_bytes,
@@ -125,12 +155,16 @@ def main(argv=None) -> int:
     p.add_argument("--pg-num", type=int, default=8)
     p.add_argument("--batch-max", type=int, default=64)
     p.add_argument("--rounds", type=int, default=2)
+    p.add_argument("--mesh", dest="mesh", action="store_true",
+                   default=None, help="force the sharded data plane on")
+    p.add_argument("--no-mesh", dest="mesh", action="store_false",
+                   help="force the sharded data plane off")
     args = p.parse_args(argv)
     res = asyncio.run(run_osd_path_bench(
         n_osds=args.osds, k=args.k, m=args.m, n_objects=args.objects,
         obj_bytes=args.obj_kib * 1024, concurrency=args.concurrency,
         pg_num=args.pg_num, batch_max=args.batch_max,
-        rounds=args.rounds))
+        rounds=args.rounds, mesh=args.mesh))
     print(json.dumps(res), flush=True)
     return 0
 

@@ -1,0 +1,32 @@
+"""donated-buffer-aliasing bad fixture: buffers read after the launch
+that consumed them -- directly, through a locally-bound jit, and one
+call away through a forwarding helper (the interprocedural case)."""
+
+import jax
+import jax.numpy as jnp
+
+_enc = jax.jit(lambda w, x: x * 2, donate_argnums=(1,))
+
+
+def launch(w, data):
+    out = _enc(w, data)
+    return out + data.sum()          # use-after-donate (direct)
+
+
+def launch_local(w, data):
+    step = jax.jit(lambda w_, x: x + 1, donate_argnums=(1,))
+    out = step(w, data)
+    total = data.mean()              # use-after-donate (local binding)
+    return out, total
+
+
+def consume(w, buf):
+    # forwards its own parameter into a donated position: callers of
+    # consume() donate `buf` whether they know it or not
+    return _enc(w, buf)
+
+
+def caller(w):
+    buf = jnp.ones((4,))
+    out = consume(w, buf)
+    return out, buf.sum()            # use-after-donate (one call away)

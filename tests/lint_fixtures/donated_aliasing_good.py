@@ -1,0 +1,41 @@
+"""donated-buffer-aliasing good fixture: reads before the launch,
+re-bound names, non-donating jits and copies are all fine."""
+
+import jax
+import jax.numpy as jnp
+
+_enc = jax.jit(lambda w, x: x * 2, donate_argnums=(1,))
+_plain = jax.jit(lambda w, x: x * 2)
+
+
+def launch(w, data):
+    total = data.sum()               # read BEFORE the launch
+    out = _enc(w, data)
+    return out, total
+
+
+def relaunch(w, data):
+    data = _enc(w, data)             # re-bound: no longer the donated
+    return data.sum()                # buffer
+
+
+def launch_copy(w, data):
+    keep = jnp.array(data, copy=True)
+    out = _enc(w, data)
+    return out, keep.sum()
+
+
+def launch_undonated(w, data):
+    out = _plain(w, data)
+    return out + data.sum()          # nothing was donated
+
+
+def consume(w, buf):
+    return _enc(w, buf)
+
+
+def caller(w):
+    buf = jnp.ones((4,))
+    before = buf.sum()               # reads precede the donation
+    out = consume(w, buf)
+    return out, before

@@ -35,6 +35,47 @@ def test_stale_candidates_sort_by_parsed_round_number(tmp_path,
     assert cands[0][1] is None
 
 
+def test_stale_fallback_carries_provenance_and_warns(tmp_path,
+                                                     monkeypatch,
+                                                     capsys):
+    """The MULTICHIP_r05-is-a-copy-of-r02 trap: an artifact emitted
+    from last-known-good must carry ``stale: true`` + ``source_round``
+    (the round the bytes were REALLY captured in), print a WARNING,
+    and never chain off an already-stale capture."""
+    import json
+    bench = _bench()
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {"value": 15.4, "unit": "GiB/s"}}))
+    # a newer round that is itself a stale copy: must be SKIPPED, not
+    # re-laundered into fresh-looking provenance
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps(
+        {"parsed": {"value": 15.4, "stale": True,
+                    "source_round": 2}}))
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "INTERIM",
+                        str(tmp_path / "BENCH_interim.json"))
+    assert bench._emit_stale("tunnel down (test)") is True
+    out, err = capsys.readouterr()
+    res = json.loads(out.strip().splitlines()[-1])
+    assert res["stale"] is True
+    assert res["source_round"] == 2          # NOT 5: r05 was a copy
+    assert res["stale_source"] == "BENCH_r02.json"
+    assert res["value"] == 15.4
+    assert "WARNING" in err and "COPY" in err
+
+
+def test_stale_fallback_returns_false_with_no_candidates(tmp_path,
+                                                         monkeypatch,
+                                                         capsys):
+    bench = _bench()
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "INTERIM",
+                        str(tmp_path / "BENCH_interim.json"))
+    assert bench._emit_stale("nothing to fall back to") is False
+    out, _ = capsys.readouterr()
+    assert out.strip() == ""                 # nothing emitted
+
+
 def test_bench_round_no_parses_and_rejects():
     bench = _bench()
     assert bench._bench_round_no("/x/BENCH_r07.json") == 7
@@ -86,6 +127,40 @@ def test_integrity_smoke_exits_zero_with_parity_and_counters():
     assert res["scalar_calls_on_batched_paths"] == 0
     assert res["value"] > 0
     assert res["fused_launches"] >= 1
+
+
+def test_osd_path_mesh_smoke_gates_hold():
+    """bench.py --osd-path --mesh --smoke is the tier-1 tripwire for
+    the sharded data plane: under 8 forced host devices the mesh
+    parity must match the scalar oracle, EXACTLY ONE device launch
+    must serve each coalesced batch (unit drive AND the in-process
+    cluster), and zero scalar CRC calls may appear on the mesh path."""
+    import json
+    import os
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--osd-path", "--mesh",
+         "--smoke"],
+        capture_output=True, text=True, cwd="/root/repo", env=env,
+        timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    res = json.loads(r.stdout.strip().splitlines()[-1])
+    assert res["metric"] == "ec_osd_path_write_GiBps"
+    assert res["value"] > 0
+    gates = res["mesh_gates"]
+    assert gates["parity"] == "ok"
+    assert gates["n_devices"] == 8
+    assert gates["launches_per_batch"] == 1.0
+    assert gates["mesh_fallbacks"] == 0
+    assert gates["scalar_calls_on_batched_paths"] == 0
+    cluster = res["mesh"]
+    assert cluster["launches"] >= 1
+    assert cluster["fallbacks"] == 0
+    assert cluster["launches_per_batch"] == 1.0
+    assert cluster["n_devices"] == 8
 
 
 def test_cluster_smoke_exits_zero_with_no_failed_ops():

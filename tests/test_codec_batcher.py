@@ -85,8 +85,13 @@ def test_ragged_tails_pad_and_slice_back_exactly():
             assert np.array_equal(par[s, 0], want[2]), s
     dump = perf.dump()
     assert dump["batches"] == 1
-    # padded launch is (4, 2, 128) = 1024 bytes vs 640 payload
-    assert dump["pad_waste_bytes"] == 4 * 2 * 128 - (a1.size + a2.size)
+    # the launch pads the batch axis to the mesh-bucketed size (power
+    # of two AND a multiple of the device count -- 8 under the
+    # conftest's forced 8-device mesh) and the waste is all counted
+    from ceph_tpu.parallel.mesh_codec import MeshCodec
+    b_pad = MeshCodec().pad_batch(3)
+    assert dump["pad_waste_bytes"] == b_pad * 2 * 128 - (a1.size
+                                                         + a2.size)
 
 
 def test_decode_groups_by_erasure_signature():
@@ -185,8 +190,12 @@ def test_drain_flush_is_prompt():
 
 
 def test_launch_error_propagates_to_all_waiters():
+    # mesh=None pins the contract on the single-device engine (with a
+    # mesh, a broken codec driver is ROUTED AROUND -- the mesh launch
+    # computes from the coefficient matrix directly; mesh-launch
+    # failures themselves degrade, pinned by test_mesh_codec)
     codec = _codec()
-    b = CodecBatcher(max_batch=2, flush_timeout=0.05)
+    b = CodecBatcher(max_batch=2, flush_timeout=0.05, mesh=None)
 
     def boom(*a, **k):
         raise RuntimeError("driver on fire")

@@ -41,6 +41,8 @@ RULE_FIXTURES = {
                             "osd/blocking_under_lock_good.py"),
     "device-path-host-sync": ("device_path_bad.py",
                               "device_path_good.py"),
+    "donated-buffer-aliasing": ("donated_aliasing_bad.py",
+                                "donated_aliasing_good.py"),
     "denc-symmetry": ("denc_symmetry_bad.py",
                       "denc_symmetry_good.py"),
     "lock-order": ("osd/lock_order_bad.py",

@@ -797,6 +797,96 @@ def _integrity_mode(deadline: float, smoke: bool) -> int:
     return 0
 
 
+def _agg_phases(phases: dict) -> dict:
+    """Aggregate per-pass phase rows into one row per phase kind."""
+    agg: dict = {}
+    for name, d in phases.items():
+        key = name.rstrip("0123456789_") or name
+        cur = agg.setdefault(key, {"seconds": 0.0, "bytes": 0})
+        cur["seconds"] = round(cur["seconds"] + d["seconds"], 4)
+        cur["bytes"] += d["bytes"]
+    for cur in agg.values():
+        cur["GiBps"] = round(
+            cur["bytes"] / max(cur["seconds"], 1e-9) / 2**30, 3)
+    return agg
+
+
+def _datapath_mode(deadline: float, smoke: bool) -> int:
+    """--datapath: the device-resident shard data path, end-to-end.
+
+    Drives write -> read-verify -> scrub -> degraded-read over real
+    BlockStores with the production encode/decode/CRC primitives,
+    twice over identical inputs: the host-round-trip baseline (every
+    consumer re-materializes shard bytes through the store; deep scrub
+    reconstructs + re-encodes) vs the DeviceShardCache path (hot shard
+    buffers stay resident; scrub verifies write-time tags over the
+    resident bytes).  Byte identity between the two runs is asserted
+    before any number is reported, and the ``datapath`` perf counters
+    must show the cached steady phases moved ZERO shard bytes through
+    the store.  --smoke keeps the workload tier-1 sized and exits
+    non-zero on any gate failure (parity, hit-rate, steady host bytes,
+    scalar CRC calls)."""
+    import asyncio
+    from ceph_tpu.tools.datapath_bench import run_datapath_bench
+
+    if smoke:
+        kwargs = dict(k=2, m=1, n_objects=6, obj_bytes=32 << 10,
+                      passes=2, reads_per_pass=2)
+    else:
+        kwargs = dict(
+            k=int(os.environ.get("BENCH_DP_K", "4")),
+            m=int(os.environ.get("BENCH_DP_M", "2")),
+            n_objects=int(os.environ.get("BENCH_DP_OBJECTS", "24")),
+            obj_bytes=int(os.environ.get("BENCH_DP_OBJ_KIB",
+                                         "256")) << 10,
+            passes=int(os.environ.get("BENCH_DP_PASSES", "10")),
+            reads_per_pass=int(os.environ.get("BENCH_DP_READS", "5")))
+    log(f"datapath mode: {kwargs} smoke={smoke}")
+    res = asyncio.new_event_loop().run_until_complete(
+        run_datapath_bench(**kwargs))
+    log(f"datapath: {res['datapath_GiBps']} GiB/s cached vs "
+        f"{res['baseline_GiBps']} GiB/s host round trip "
+        f"({res['vs_host_roundtrip']}x); steady host bytes "
+        f"{res['steady_host_bytes_read']}, hits {res['cache_hits']}")
+    RESULT.update({
+        "metric": "datapath_write_scrub_degraded_GiBps",
+        "value": res["datapath_GiBps"],
+        "unit": "GiB/s",
+        "vs_baseline": res["vs_host_roundtrip"],
+        "baseline_note": "identical drive with the shard cache "
+                         "detached: every read re-materializes "
+                         "through the store and deep scrub "
+                         "reconstructs + re-encodes (the pre-cache "
+                         "pipeline)",
+        "smoke": smoke,
+        **{key: res[key] for key in
+           ("k", "m", "n_objects", "obj_bytes", "passes",
+            "reads_per_pass", "baseline_GiBps", "cache_hits",
+            "steady_host_bytes_read", "steady_host_reads",
+            "host_bytes_avoided", "scalar_calls_on_batched_paths",
+            "parity")},
+        "cached_phases": _agg_phases(res["cached_run"]["phases"]),
+        "baseline_phases": _agg_phases(res["baseline_run"]["phases"]),
+    })
+    emit()
+    rc = 0
+    if res["parity"] != "ok":
+        log("ERROR: datapath parity gate failed")
+        rc = 1
+    if not res["cache_hits"]:
+        log("ERROR: the cached drive never hit the cache")
+        rc = 1
+    if res["steady_host_bytes_read"] != 0:
+        log("ERROR: cache-hit steady phases moved shard bytes "
+            "through the store")
+        rc = 1
+    if res["scalar_calls_on_batched_paths"] != 0:
+        log("ERROR: scalar CRC calls observed on the datapath "
+            "steady phases")
+        rc = 1
+    return rc
+
+
 def _cluster_spec(smoke: bool):
     """The --cluster WorkloadSpec: smoke = small, deterministic,
     tier-1-fast; full = the >=64-OSD / >=10k-object acceptance shape
@@ -1080,6 +1170,9 @@ def main() -> int:
             mesh=("--mesh" in sys.argv[1:]
                   or bool(os.environ.get("BENCH_OSD_MESH"))),
             smoke="--smoke" in sys.argv[1:])
+    if "--datapath" in sys.argv[1:] or os.environ.get("BENCH_DATAPATH"):
+        _ALLOW_STALE = False
+        return _datapath_mode(deadline, "--smoke" in sys.argv[1:])
     if "--cluster" in sys.argv[1:] or os.environ.get("BENCH_CLUSTER"):
         _ALLOW_STALE = False
         return _cluster_mode(deadline, "--smoke" in sys.argv[1:])

@@ -9,6 +9,7 @@ from . import (        # noqa: F401
     donated_aliasing,
     dropped_task,
     hole_sentinel,
+    hot_config,
     jit_stability,
     lock_order,
     perf_coherence,

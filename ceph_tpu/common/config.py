@@ -137,6 +137,21 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_ec_mesh_donate", OPT_BOOL, True,
            "donate stripe buffers to mesh launches (consume the "
            "device copy in place instead of defensive-copying it)"),
+    Option("osd_datapath_cache_enabled", OPT_BOOL, True,
+           "keep hot shard buffers device-resident across encode -> "
+           "commit -> read-verify -> scrub -> decode (the (object, "
+           "shard) cache in os/device_cache.py)"),
+    Option("osd_datapath_cache_bytes", OPT_INT, 64 << 20,
+           "byte budget of the device-resident shard cache (LRU past "
+           "it)", min=0),
+    Option("osd_datapath_cache_entry_max", OPT_INT, 8 << 20,
+           "largest single shard buffer the cache will hold (bigger "
+           "shards always read through the store)", min=0),
+    Option("osd_ec_rmw_delta_enabled", OPT_BOOL, True,
+           "partial-stripe writes delta-update parity in place "
+           "(parity' = parity XOR encode(delta)) instead of "
+           "re-encoding whole stripes; unchanged data shards ship "
+           "version-stamp-only sub-writes"),
     Option("osd_heartbeat_max_peers", OPT_INT, 10,
            "heartbeat fanout cap: PG peers + id-ring neighbors "
            "instead of the O(N^2) full mesh (0 = uncapped)", min=0),

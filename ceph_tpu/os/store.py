@@ -20,6 +20,21 @@ from .transaction import Transaction
 class ObjectStore:
     """Abstract store: collections of objects (data, xattrs, omap)."""
 
+    # device-resident shard cache (os/device_cache.py), attached by the
+    # OSD.  EVERY implementation must call _note_txn_for_cache() before
+    # applying a transaction: the store boundary is where ALL mutation
+    # paths (client writes, recovery pushes, scrub repair, test bit-rot
+    # injection) converge, so invalidating here is what makes the cache
+    # provably coherent with stored bytes.
+    shard_cache = None
+
+    def attach_shard_cache(self, cache) -> None:
+        self.shard_cache = cache
+
+    def _note_txn_for_cache(self, txn: Transaction) -> None:
+        if self.shard_cache is not None:
+            self.shard_cache.note_txn(txn)
+
     def mount(self) -> None: ...
     def umount(self) -> None: ...
 
@@ -102,6 +117,7 @@ class MemStore(ObjectStore):
                     pending.add(op.coll)
                 elif op.coll not in pending:
                     raise KeyError(f"no collection {op.coll}")
+            self._note_txn_for_cache(txn)
             for op in txn.ops:
                 self._apply(op)
 
@@ -240,6 +256,7 @@ class DBStore(ObjectStore):
                 "PRIMARY KEY (coll, oid, key))")
 
     def queue_transaction(self, txn: Transaction) -> None:
+        self._note_txn_for_cache(txn)
         conn = self._conn()
         with conn:
             for op in txn.ops:

@@ -385,6 +385,15 @@ class ECBackend(PGBackend):
         self._read_retries = self._cfg("osd_ec_read_retries", 3)
         self._read_timeout = self._cfg("osd_ec_read_timeout", 5.0)
         self._read_backoff = self._cfg("osd_ec_read_backoff", 0.25)
+        # device-resident shard cache (os/device_cache.py): full-shard
+        # reads, ranged RMW slices, scrub verifies and the write-path
+        # identity stamp all serve from residency instead of
+        # round-tripping the store.  None in bare tests / when disabled.
+        self.dcache = getattr(self.osd, "shard_cache", None)
+        # partial-stripe writes delta-update parity in place
+        # (MeshCodec.rmw / CodecBatcher.rmw) instead of re-encoding
+        # whole stripes; snapshot, never read per write
+        self._rmw_delta = self._cfg("osd_ec_rmw_delta_enabled", True)
 
     def _count(self, key: str, by: int = 1) -> None:
         if self.perf_degraded is not None:
@@ -444,19 +453,45 @@ class ECBackend(PGBackend):
             self.cache.invalidate(oid)
 
     # -- logical object reconstruction --------------------------------------
-    def _local_shard(self, oid: str, rng: tuple[int, int] | None = None):
-        """(buf, size, version) for my shard; absent -> (b'', 0, (0,0)).
+    def _local_entry(self, oid: str,
+                     rng: tuple[int, int] | None = None):
+        """(buf, size, ver, label, crc, cached) for my shard; absent
+        -> (b'', 0, (0,0), ..., False).
 
         ``rng`` = (chunk_off, chunk_len) reads only that slice of the
-        shard (the partial-stripe RMW read phase)."""
+        shard (the partial-stripe RMW read phase).  The device-resident
+        cache serves full reads AND ranged slices without touching the
+        store; misses read through the store's checksum-on-read path
+        and (full reads) populate the cache so scrub re-verifies and
+        repeat degraded reads hit.  ``cached`` marks content that was
+        verified at fill/write time and needs no CRC re-hash."""
+        cache = self.dcache
+        if cache is not None:
+            e = cache.get(self.coll, oid)
+            if e is not None:
+                buf = e.buf if rng is None \
+                    else e.buf[rng[0]:rng[0] + rng[1]]
+                return buf, e.size, e.ver, e.shard, e.crc, True
         off, length = rng if rng else (0, None)
         try:
             raw = self.store.read(self.coll, oid, off, length)
         except FileNotFoundError:
             raw = b""
         sx = self.store.getattr(self.coll, oid, SIZE_XATTR)
+        size = int(sx) if sx else 0
         ver = ver_decode(self.store.getattr(self.coll, oid, VER_XATTR))
-        return np.frombuffer(raw, np.uint8), int(sx) if sx else 0, ver
+        label = self.shard_label(oid)
+        crc_raw = self.store.getattr(self.coll, oid, CRC_XATTR)
+        crc = int(crc_raw) if crc_raw is not None else None
+        buf = np.frombuffer(raw, np.uint8)
+        if cache is not None:
+            cache.note_host_read(len(raw))
+            if rng is None and (raw or size):
+                # read-through fill: content just came through the
+                # store's verified read path, with its identity xattrs
+                cache.put(self.coll, oid, buf, size=size, ver=ver,
+                          shard=label, crc=crc)
+        return buf, size, ver, label, crc, False
 
     def _label_ok(self, shard: int, label, buf, ver) -> bool:
         """Is a stored/reported shard label consistent with serving
@@ -491,17 +526,16 @@ class ECBackend(PGBackend):
         out: dict[int, tuple] = {}
         failed: set[int] = set()
         relabeled: dict[int, tuple] = {}
-        entries: list[tuple] = []        # (shard, label, crc, buf, size, ver)
+        # (shard, label, crc, buf, size, ver, trusted); trusted marks
+        # cache-resident content verified at fill/write time
+        entries: list[tuple] = []
 
         remote = []
         for s in shards:
             if avail[s] == self.osd.whoami:
-                buf, size, ver = self._local_shard(oid, rng)
-                crc_raw = self.store.getattr(self.coll, oid, CRC_XATTR)
-                entries.append(
-                    (s, self.shard_label(oid),
-                     int(crc_raw) if crc_raw is not None else None,
-                     buf, size, ver))
+                buf, size, ver, label, crc, cached = \
+                    self._local_entry(oid, rng)
+                entries.append((s, label, crc, buf, size, ver, cached))
             else:
                 remote.append(s)
         if remote:
@@ -522,17 +556,23 @@ class ECBackend(PGBackend):
                 entries.append(
                     (s, rep.data.get("shard"), rep.data.get("crc"),
                      buf, rep.data.get("size", 0),
-                     tuple(rep.data.get("ver", (0, 0)))))
+                     tuple(rep.data.get("ver", (0, 0))), False))
         # whole-shard fetches verify their CRC tags in ONE batched pass
         # over every gathered buffer (the hot read path used to re-hash
-        # each reply with its own scalar host call)
-        crcs = None
-        if rng is None and entries:
-            from ..ops.crc32c_batch import crc32c_batch
-            crcs = crc32c_batch([e[3] for e in entries])
+        # each reply with its own scalar host call); cache-resident
+        # buffers were verified when they became resident and skip the
+        # re-hash entirely -- deep scrub re-checks them on its cadence
+        have: dict[int, int] = {}
+        if rng is None:
+            idx = [i for i, e in enumerate(entries) if not e[6]]
+            if idx:
+                from ..ops.crc32c_batch import crc32c_batch
+                crcs = crc32c_batch([entries[i][3] for i in idx])
+                have = {i: int(c) for i, c in zip(idx, crcs)}
 
-        for i, (s, label, crc, buf, size, ver) in enumerate(entries):
-            have = None if crcs is None else int(crcs[i])
+        for i, (s, label, crc, buf, size, ver,
+                trusted) in enumerate(entries):
+            hv = have.get(i)
             if not self._label_ok(s, label, buf, ver):
                 self._count("shard_mismatch")
                 failed.add(s)
@@ -540,14 +580,14 @@ class ECBackend(PGBackend):
                 # not garbage (ranged reads can't re-check the whole-
                 # shard crc; the label xattr alone vouches there)
                 if label is not None and int(label) >= 0 and \
-                        (rng is not None or crc is None
+                        (rng is not None or crc is None or trusted
                          or shard_crc_matches(buf, crc,
-                                              precomputed=have)):
+                                              precomputed=hv)):
                     relabeled.setdefault(int(label), (buf, size, ver))
                 continue
-            if rng is None and crc is not None \
+            if rng is None and crc is not None and not trusted \
                     and not shard_crc_matches(buf, crc,
-                                              precomputed=have):
+                                              precomputed=hv):
                 self._count("crc_mismatch")
                 failed.add(s)
                 continue
@@ -871,6 +911,11 @@ class ECBackend(PGBackend):
         oid = entry.oid
         sw, cs = self.sinfo.stripe_width, self.sinfo.chunk_size
         stripe_data = await self._read_stripes(oid, stripes, old_size)
+        # snapshot the OLD stripe bytes before merging: the delta-RMW
+        # parity path encodes (new XOR old) and XORs it onto the stored
+        # parity (GF linearity) instead of re-encoding whole stripes
+        old_data = {s: bytes(d) for s, d in stripe_data.items()} \
+            if self._rmw_delta else {}
         # merge the mutations into the touched stripes; `cur` tracks the
         # running logical size so a zero clamps against what earlier
         # writes in this vector extended, not the stale old_size
@@ -895,25 +940,92 @@ class ECBackend(PGBackend):
                     stripe_data[s][a - lo:b - lo] = b"\0" * (b - a)
                 else:
                     stripe_data[s][a - lo:b - lo] = data[a - off:b - off]
-        # encode each contiguous run in one driver call (runs submit
+        # process each contiguous run in one driver call (runs submit
         # concurrently so the batcher coalesces them — and any other
         # op's stripes — into a single launch); collect ranged
-        # per-shard writes
+        # per-shard writes.  Runs whose stripes already exist take the
+        # DELTA path: parity' = parity XOR encode(new XOR old) -- one
+        # rmw launch, and data shards whose chunks did not change ship
+        # NO payload (their sub-write carries only the version stamp),
+        # so the per-write byte movement drops from (k+m) chunks per
+        # stripe to (changed data chunks + m parity chunks).  Runs past
+        # old EOF (no stored parity) and delta-ineligible codecs keep
+        # the full re-encode.
         acting = self.pg.acting
         shard_writes: list[list[tuple[int, bytes]]] = [
             [] for _ in acting]
         runs = self._runs(stripes)
-        blobs = [b"".join(bytes(stripe_data[s])
-                          for s in range(lo, hi + 1))
-                 for lo, hi in runs]
-        encoded = await asyncio.gather(
-            *(self.sinfo.encode_async(self.codec, blob,
-                                      batcher=self.batcher)
-              for blob in blobs))
-        for (lo, hi), shards in zip(runs, encoded):
-            for shard in range(len(acting)):
-                shard_writes[shard].append(
-                    (lo * cs, shards[shard].tobytes()))
+        n_old = self.sinfo.logical_to_next_stripe_offset(old_size) // sw
+        dpos = self.sinfo.data_positions(self.codec)
+        ppos = [i for i in range(self.sinfo.k + self.sinfo.m)
+                if i not in dpos]
+        from .codec_batcher import CodecBatcher
+        delta_ok = (self._rmw_delta and self.batcher is not None
+                    and CodecBatcher.supports(self.codec)
+                    and len(acting) == self.sinfo.k + self.sinfo.m)
+        avail = {shard: osd for shard, osd in enumerate(acting)
+                 if osd >= 0 and self.osd.osd_is_up(osd)}
+
+        async def _full_run(lo: int, hi: int):
+            """Re-encode the whole run: every shard gets its chunk."""
+            blob = b"".join(bytes(stripe_data[s])
+                            for s in range(lo, hi + 1))
+            shards = await self.sinfo.encode_async(
+                self.codec, blob, batcher=self.batcher)
+            if self.batcher is not None:
+                self.batcher.note_rmw(delta=False)
+            return [(shard, lo * cs, shards[shard].tobytes())
+                    for shard in range(len(acting))]
+
+        async def _delta_run(lo: int, hi: int):
+            """Delta-update parity in place; ship only changed data
+            chunks + the m parity chunks."""
+            n = hi - lo + 1
+            rng = (lo * cs, n * cs)
+            pbufs, pfailed, _ = await self._fetch_shards(
+                oid, [p for p in ppos if p in avail], avail, rng,
+                self._read_timeout)
+            if pfailed or set(ppos) - set(pbufs) or any(
+                    len(pbufs[p][0]) != n * cs for p in ppos):
+                # a parity source is down/stale/short: the delta has
+                # nothing sound to XOR onto -- re-encode instead
+                return await _full_run(lo, hi)
+            old_parity = np.stack(
+                [np.asarray(pbufs[p][0], np.uint8).reshape(n, cs)
+                 for p in ppos], axis=1)              # (n, m, cs)
+            new_arr = np.frombuffer(
+                b"".join(bytes(stripe_data[s])
+                         for s in range(lo, hi + 1)),
+                np.uint8).reshape(n, self.sinfo.k, cs)
+            old_arr = np.frombuffer(
+                b"".join(old_data[s] for s in range(lo, hi + 1)),
+                np.uint8).reshape(n, self.sinfo.k, cs)
+            delta = new_arr ^ old_arr
+            new_parity = await self.batcher.rmw(self.codec,
+                                                old_parity, delta)
+            self.batcher.note_rmw(delta=True)
+            out = []
+            changed = delta.any(axis=2)               # (n, k)
+            for j, p in enumerate(dpos):
+                for i in range(n):
+                    if changed[i, j]:
+                        out.append((p, (lo + i) * cs,
+                                    new_arr[i, j].tobytes()))
+            for r, p in enumerate(ppos):
+                out.append((p, lo * cs, np.ascontiguousarray(
+                    new_parity[:, r]).reshape(-1).tobytes()))
+            return out
+
+        async def _run_one(lo: int, hi: int):
+            if delta_ok and hi < n_old and all(
+                    s in old_data for s in range(lo, hi + 1)):
+                return await _delta_run(lo, hi)
+            return await _full_run(lo, hi)
+
+        for writes in await asyncio.gather(
+                *(_run_one(lo, hi) for lo, hi in runs)):
+            for shard, off, buf in writes:
+                shard_writes[shard].append((off, buf))
         for s in stripes:
             self.cache.put(oid, s, bytes(stripe_data[s]))
         shard_len = self.sinfo.object_size_to_shard_size(new_size)
@@ -961,10 +1073,18 @@ class ECBackend(PGBackend):
                 shard = self.pg.shard_id
         if shard is not None and self.pg.shard_id is None:
             self.pg.shard_id = shard
+        # final shard content for the device-resident cache: full-shard
+        # writes hand their payload straight through; ranged RMW writes
+        # patch the PRE-txn resident copy (captured before the store's
+        # coherence invalidation fires) so the identity stamp never
+        # reads the shard back from the store
+        content = size = vtuple = None
         if w.get("remove"):
             txn.remove(self.coll, oid)
         elif w.get("writes") is not None:
             # partial-stripe RMW: ranged chunk writes + final length
+            pre = self.dcache.get(self.coll, oid) \
+                if self.dcache is not None else None
             txn.touch(self.coll, oid)
             for i, (off, ln) in enumerate(w["writes"]):
                 buf = segs[i] if i < len(segs) else b""
@@ -975,6 +1095,14 @@ class ECBackend(PGBackend):
                         str(w["size"]).encode())
             txn.setattr(self.coll, oid, VER_XATTR,
                         ver_encode(entry.version))
+            if pre is not None:
+                arr = np.zeros(w["shard_len"], np.uint8)
+                n = min(pre.buf.size, w["shard_len"])
+                arr[:n] = pre.buf[:n]
+                for (off, ln), buf in zip(w["writes"], segs):
+                    arr[off:off + ln] = np.frombuffer(buf, np.uint8)
+                content, size = arr, w["size"]
+                vtuple = (entry.version.epoch, entry.version.version)
         elif w.get("touch"):
             # create-only / attr-only: never rewrite shard content
             txn.touch(self.coll, oid)
@@ -991,26 +1119,42 @@ class ECBackend(PGBackend):
                         str(w["size"]).encode())
             txn.setattr(self.coll, oid, VER_XATTR,
                         ver_encode(entry.version))
+            if len(buf) == w["shard_len"]:
+                content, size = buf, w["size"]
+                vtuple = (entry.version.epoch, entry.version.version)
         apply_mutations(txn, self.coll, oid, attr_muts)
         self.pg.append_log_and_meta(txn, entry)
         self.store.queue_transaction(txn)
         if not w.get("remove"):
-            self._stamp_identity(oid, shard, crc=w.get("crc"))
+            self._stamp_identity(oid, shard, crc=w.get("crc"),
+                                 content=content, size=size,
+                                 ver=vtuple)
 
     def _stamp_identity(self, oid: str, shard: int | None,
-                        crc: int | None = None) -> None:
+                        crc: int | None = None, content=None,
+                        size: int | None = None,
+                        ver: tuple | None = None) -> None:
         """Post-commit identity tag: shard label + CRC of the FINAL
         shard content.  Full-shard writes pass the ``crc`` the codec
         launch already computed (no read-back, no re-hash); ranged RMW
-        writes touch slices, so their digest is taken from the store
+        writes pass the patched resident ``content`` (no store
+        read-back) or, with no resident copy, read back from the store
         after the txn applied (queue_transaction is synchronous, no
-        interleaving await) -- still through the batched kernel."""
+        interleaving await) -- still through the batched kernel.
+
+        When the final content is in hand it becomes the cache entry
+        for ``(coll, oid)`` -- the write's encoded bytes flow straight
+        into residency, so the next read/scrub/decode never touches
+        the store."""
         if crc is None:
-            try:
-                cur = self.store.read(self.coll, oid, 0, None)
-            except FileNotFoundError:
-                return
-            crc = shard_crc(cur)
+            if content is None:
+                try:
+                    content = self.store.read(self.coll, oid, 0, None)
+                except FileNotFoundError:
+                    return
+                if self.dcache is not None:
+                    self.dcache.note_host_read(len(content))
+            crc = shard_crc(content)
         txn = Transaction()
         if shard is not None:
             txn.setattr(self.coll, oid, SHARD_XATTR,
@@ -1018,6 +1162,10 @@ class ECBackend(PGBackend):
         txn.setattr(self.coll, oid, CRC_XATTR,
                     str(int(crc)).encode())
         self.store.queue_transaction(txn)
+        if self.dcache is not None and content is not None \
+                and size is not None and ver is not None:
+            self.dcache.put(self.coll, oid, content, size=size,
+                            ver=ver, shard=shard, crc=int(crc))
 
     # -- read path ----------------------------------------------------------
     async def object_read(self, oid, off, length) -> bytes:

@@ -187,17 +187,40 @@ class CodecBatcher:
         return await self._submit("decode", codec, survivors,
                                   tuple(int(e) for e in erasures))
 
+    async def rmw(self, codec, old_parity: np.ndarray,
+                  delta: np.ndarray) -> np.ndarray:
+        """Delta-encoded partial-stripe parity update: (n, m, L) old
+        parity + (n, k, L) data delta (zeros outside the written
+        range) -> (n, m, L) new parity = old XOR encode(delta), by GF
+        linearity.  Coalesces across concurrently-submitting ops like
+        encode/decode; through the mesh the old-parity device buffer is
+        donated and ALIASED in place (MeshCodec.rmw), so the update
+        never holds two parity copies."""
+        old_parity = np.ascontiguousarray(old_parity, np.uint8)
+        assert old_parity.ndim == 3, old_parity.shape
+        return await self._submit("rmw", codec, delta, (),
+                                  old=old_parity)
+
     def note_fallback(self) -> None:
         """A caller took the per-op path for a non-batch codec."""
         if self.perf is not None:
             self.perf.inc("fallback_ops")
 
+    def note_rmw(self, delta: bool) -> None:
+        """A partial-stripe write run took the delta path (rmw launch)
+        or fell back to a full re-encode."""
+        if self.perf is not None:
+            self.perf.inc("rmw_delta_runs" if delta
+                          else "rmw_full_runs")
+
     async def _submit(self, kind: str, codec, arr: np.ndarray,
-                      extra: tuple, want_crc: bool = False):
+                      extra: tuple, want_crc: bool = False, old=None):
         arr = np.ascontiguousarray(arr, dtype=np.uint8)
         assert arr.ndim == 3, arr.shape
         if self._closed:
             # late stragglers during shutdown: launch solo
+            if kind == "rmw":
+                return old ^ self._launch_one("encode", codec, (), arr)
             out = self._launch_one(kind, codec, extra, arr)
             if want_crc:
                 return out, self._host_chunk_crcs(arr, out)
@@ -208,7 +231,7 @@ class CodecBatcher:
             grp = self._groups[key] = _Group(codec, kind, extra)
         loop = asyncio.get_event_loop()
         fut = loop.create_future()
-        grp.items.append((arr, fut, want_crc))
+        grp.items.append((arr, fut, want_crc, old))
         grp.n_stripes += arr.shape[0]
         if grp.n_stripes >= self.max_batch:
             self._flush(key, "full")
@@ -289,22 +312,36 @@ class CodecBatcher:
         from ..ops.gf2kernels import bucket_batch
         items = grp.items
         k = items[0][0].shape[1]
-        lane = max(a.shape[2] for a, _, _ in items)
-        total = sum(a.shape[0] for a, _, _ in items)
+        lane = max(a.shape[2] for a, _, _, _ in items)
+        total = sum(a.shape[0] for a, _, _, _ in items)
         mesh = self._mesh_for(grp.codec)
         b = mesh.pad_batch(total) if mesh is not None \
             else bucket_batch(total)
-        payload = sum(a.size for a, _, _ in items)
+        payload = sum(a.size for a, _, _, _ in items)
         if len(items) == 1 and b == total:
             batch = items[0][0]
         else:
             batch = np.zeros((b, k, lane), np.uint8)
             row = 0
-            for a, _, _ in items:
+            for a, _, _, _ in items:
                 n, _, l = a.shape
                 batch[row:row + n, :, :l] = a
                 row += n
-        want_crc = any(w for _, _, w in items)
+        old_batch = None
+        if grp.kind == "rmw":
+            # the old-parity side rides the same padding: zero delta
+            # rows encode to zero, so padded parity passes through
+            m_dim = items[0][3].shape[1]
+            if len(items) == 1 and b == total:
+                old_batch = items[0][3]
+            else:
+                old_batch = np.zeros((b, m_dim, lane), np.uint8)
+                row = 0
+                for a, _, _, old in items:
+                    n, _, l = a.shape
+                    old_batch[row:row + n, :, :l] = old
+                    row += n
+        want_crc = any(w for _, _, w, _ in items)
         crcs = None
         try:
             out = None
@@ -315,7 +352,9 @@ class CodecBatcher:
                 # mesh failure degrades to the single-device ladder
                 # below instead of failing every waiter.
                 try:
-                    if grp.kind == "encode" and want_crc \
+                    if grp.kind == "rmw":
+                        out = mesh.rmw(grp.codec, old_batch, batch)
+                    elif grp.kind == "encode" and want_crc \
                             and self._fused_crc_ok():
                         out, crcs = mesh.encode(grp.codec, batch,
                                                 with_crc=True)
@@ -335,6 +374,10 @@ class CodecBatcher:
                         self.perf.inc("mesh_fallbacks")
             if out is not None:
                 pass
+            elif grp.kind == "rmw":
+                # single-device delta: parity' = parity ^ encode(delta)
+                out = old_batch ^ self._launch_one("encode", grp.codec,
+                                                   (), batch)
             elif want_crc and grp.kind == "encode" \
                     and hasattr(grp.codec, "encode_batch_crc") \
                     and self._fused_crc_ok():
@@ -351,12 +394,12 @@ class CodecBatcher:
                     if self.perf is not None:
                         self.perf.inc("crc_host_batches")
         except Exception as e:
-            for _, fut, _ in items:
+            for _, fut, _, _ in items:
                 if not fut.done():
                     fut.set_exception(e)
             return
         row = 0
-        for a, fut, w in items:
+        for a, fut, w, _ in items:
             n, _, l = a.shape
             if not fut.done():
                 res = out[row:row + n, :, :l]

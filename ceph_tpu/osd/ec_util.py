@@ -208,7 +208,7 @@ class StripeInfo:
         if n == 0:
             return {i: np.zeros(0, np.uint8) for i in want}
         if want <= have or not erasures:
-            # lint: disable=device-path-host-sync -- normalizes host-gathered shard buffers, no device data in flight
+            # lint: disable=device-path-host-sync -- view-normalizes gathered/cache-resident ndarrays (no copy, no transfer)
             return {i: np.asarray(shard_bufs[i], dtype=np.uint8)
                     for i in want}
         if len(erasures) > m or len(have) < k:
@@ -217,14 +217,14 @@ class StripeInfo:
             return self.decode(codec, shard_bufs, want)
         decode_index = decode_index_for(k, set(erasures))
         survivors = np.stack(
-            # lint: disable=device-path-host-sync -- input marshal: host network buffers feeding the launch
+            # lint: disable=device-path-host-sync -- the single input marshal: network/cache-resident buffers stacked once for the launch
             [np.asarray(shard_bufs[i], dtype=np.uint8).reshape(n, cs)
              for i in decode_index], axis=1)          # (n, k, cs)
         rec = await batcher.decode(codec, tuple(erasures), survivors)
         out: dict[int, np.ndarray] = {}
         for i in want:
             if i in shard_bufs:
-                # lint: disable=device-path-host-sync -- passthrough of host-gathered shards alongside decoded ones
+                # lint: disable=device-path-host-sync -- view passthrough of gathered/cache-resident shards alongside decoded ones
                 out[i] = np.asarray(shard_bufs[i], dtype=np.uint8)
             else:
                 out[i] = np.ascontiguousarray(

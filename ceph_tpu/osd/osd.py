@@ -151,6 +151,18 @@ class OSD:
         from .codec_batcher import CodecBatcher
         self.codec_batcher = CodecBatcher.from_config(
             self.config, perf=self.perf.create("ec_batch"))
+        # device-resident shard cache (os/device_cache.py): hot shard
+        # buffers stay resident across encode -> commit -> read-verify
+        # -> scrub -> decode instead of round-tripping the store.
+        # Attached to the store UNCONDITIONALLY (None detaches): the
+        # store boundary invalidates on every mutating txn, and a
+        # revived OSD re-attaching a fresh (empty) cache is what makes
+        # kill/revive incapable of serving stale resident bytes.
+        from ..os.device_cache import DeviceShardCache
+        from ..os.device_cache import PERF as _datapath_perf
+        self.shard_cache = DeviceShardCache.from_config(self.config)
+        self.store.attach_shard_cache(self.shard_cache)
+        self.perf.adopt(_datapath_perf)
         self._notify_serial = itertools.count(1)
         self._notify_waiters: dict[str, asyncio.Future] = {}
         # TrackedOp/OpTracker (src/common/TrackedOp.h): in-flight op
@@ -1220,6 +1232,25 @@ class OSD:
             oid = msg.data["oid"]
             off = int(msg.data.get("off", 0))
             length = msg.data.get("len")     # None = whole shard
+            # serve from the device-resident shard cache when the
+            # bytes are resident: the reply (identity xattrs included)
+            # never touches the store -- the wire segment is the one
+            # unavoidable materialization of a remote read
+            entry = self.shard_cache.get(pg.coll, oid) \
+                if self.shard_cache is not None else None
+            if entry is not None:
+                arr = entry.buf if length is None \
+                    else entry.buf[off:off + length]
+                buf = arr.tobytes()
+                data["size"] = entry.size
+                data["ver"] = list(entry.ver)
+                if entry.shard is not None:
+                    data["shard"] = entry.shard
+                if entry.crc is not None:
+                    data["crc"] = entry.crc
+                await conn.send(Message("ec_subop_read_reply", data,
+                                        segments=[buf]))
+                return
             try:
                 buf = self.store.read(pg.coll, oid, off, length)
             except FileNotFoundError:
@@ -1242,6 +1273,15 @@ class OSD:
             crc = self.store.getattr(pg.coll, oid, CRC_XATTR)
             if crc is not None:
                 data["crc"] = int(crc)
+            if self.shard_cache is not None:
+                self.shard_cache.note_host_read(len(buf))
+                if length is None and off == 0 and (buf or data["size"]):
+                    # read-through fill: repeat remote reads of a hot
+                    # shard stop re-materializing it from the store
+                    self.shard_cache.put(
+                        pg.coll, oid, buf, size=data["size"],
+                        ver=tuple(data["ver"]), shard=label,
+                        crc=data.get("crc"))
         await conn.send(Message("ec_subop_read_reply", data,
                                 segments=[buf]))
 

@@ -34,8 +34,12 @@ async def build_scrub_map(store, coll: str,
     daemon falsely reported down.  Deep-scrub data digests gather the
     object payloads and go through ONE batched ``crc32c_batch`` call
     per chunk of the collection instead of a scalar host call per
-    object (the last per-object CRC loop on the scrub path)."""
+    object (the last per-object CRC loop on the scrub path).  Objects
+    resident in the store's device shard cache digest WITHOUT a store
+    read: the write-time CRC tag (when carried) IS the digest, else
+    the resident buffer joins the batched pass directly."""
     import asyncio
+    cache = getattr(store, "shard_cache", None)
     out: dict[str, dict] = {}
     pending: list[tuple[str, bytes]] = []   # (oid, payload) awaiting CRC
 
@@ -66,7 +70,20 @@ async def build_scrub_map(store, coll: str,
             .encode()).hexdigest()
         out[oid] = entry
         if deep:
-            pending.append((oid, bytes(store.read(coll, oid, 0, None))))
+            resident = cache.get(coll, oid) \
+                if cache is not None and (coll, oid) in cache else None
+            if resident is not None and resident.crc is not None:
+                entry["data_digest"] = resident.crc
+                from ..os.device_cache import PERF as DATAPATH_PERF
+                DATAPATH_PERF.inc("scrub_cached_digests")
+                continue
+            if resident is not None:
+                payload = resident.buf          # no store round trip
+            else:
+                payload = bytes(store.read(coll, oid, 0, None))
+                if cache is not None:
+                    cache.note_host_read(len(payload))
+            pending.append((oid, payload))
             if len(pending) >= _DIGEST_BATCH:
                 flush_digests()
     flush_digests()
@@ -168,14 +185,23 @@ async def _repair_replicated(pg, oid: str, auth_osds: list[int],
 
 
 async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
-    """Deep EC scrub: re-encode from k shards, compare all stored
-    shards byte-for-byte against the canonical encode.
+    """Deep EC scrub: verify every stored shard against its write-time
+    identity, re-encoding only when something disagrees.
 
-    The canonical re-encode rides the per-OSD CodecBatcher (one
-    ``encode_batch`` launch per object instead of a per-stripe host
-    loop) and the per-shard CRC tag checks digest all gathered shard
-    buffers through one ``crc32c_batch`` call per object."""
+    Shards whose bytes are device-cache-resident verify with ONE
+    device CRC launch over the resident buffer (``crc32c_resident``)
+    against the write-time tag -- zero store reads, zero host passes
+    over the payload.  When EVERY acting shard verifies (label ==
+    position, tag matches recomputed CRC, one version, consistent
+    lengths) the parity relationship is attested transitively: the
+    tags were computed IN the encode launch that produced the parity,
+    so a fully-tag-verified object needs no reconstruct + re-encode.
+    Anything off -- a missing tag, a mismatch, mixed versions --
+    falls back to the canonical path: reconstruct from k shards,
+    re-encode through the CodecBatcher, byte-compare every stored
+    shard (bit rot injected under a shard's tag is caught there)."""
     import numpy as np
+    from ..os.device_cache import PERF as DATAPATH_PERF
     res = ScrubResult(pg.pgid)
     backend: ECBackend = pg.backend
     oids = [o for o in pg.osd.store.list_objects(pg.coll)
@@ -184,31 +210,20 @@ async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
     from .backend import (CRC_XATTR, SHARD_XATTR, VER_XATTR, shard_crc,
                           shard_crc_matches)
     for oid in oids:
-        bufs, size, ver = await backend._gather_shards(
-            oid, need_shards=set(range(backend.k)))
-        if not bufs:
-            continue
-        logical = await backend.sinfo.reconstruct_logical_async(
-            backend.codec, bufs, batcher=backend.batcher)
-        pad = backend.sinfo.logical_to_next_stripe_offset(size)
-        canonical = await backend.sinfo.encode_async(
-            backend.codec, logical[:pad].ljust(pad, b"\0"),
-            batcher=backend.batcher)
-        # fetch every stored shard; compare bytes AND the write-time
-        # identity tags (shard label / crc) the degraded-read path
-        # trusts -- scrub is where silent tag rot gets caught
-        stored: list[tuple[int, bytes, object, object]] = []
+        # fetch every stored shard + its write-time identity tags
+        # (shard label / crc / version) -- scrub is where silent tag
+        # rot gets caught.  Local shards ride the device cache.
+        stored: list[tuple] = []     # (shard, raw, label, crc, ver, res)
+        n_acting = 0
         for shard, osd_id in enumerate(pg.acting):
             if osd_id < 0 or not pg.osd.osd_is_up(osd_id):
                 continue
+            n_acting += 1
             if osd_id == pg.whoami:
-                try:
-                    raw = pg.osd.store.read(pg.coll, oid, 0, None)
-                except FileNotFoundError:
-                    raw = b""
-                label = backend.shard_label(oid)
-                crc = pg.osd.store.getattr(pg.coll, oid, CRC_XATTR)
-                crc = int(crc) if crc is not None else None
+                buf, _, over, label, crc, cached = \
+                    backend._local_entry(oid)
+                stored.append((shard, buf, label, crc, tuple(over),
+                               cached))
             else:
                 replies = await pg.osd.fanout_and_wait(
                     [(osd_id, "ec_subop_read",
@@ -219,19 +234,61 @@ async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
                     continue
                 raw = (replies[0].segments[0]
                        if replies[0].segments else b"")
-                label = replies[0].data.get("shard")
-                crc = replies[0].data.get("crc")
-            stored.append((shard, bytes(raw), label, crc))
-        have_crcs = crc32c_batch([raw for _, raw, _, _ in stored])
+                stored.append((shard, raw,
+                               replies[0].data.get("shard"),
+                               replies[0].data.get("crc"),
+                               tuple(replies[0].data.get("ver",
+                                                         (0, 0))),
+                               False))
+        if not stored:
+            continue
+        # resident buffers verify via the device kernel; the rest in
+        # one batched host pass
+        have_crcs: dict[int, int] = {}
+        host_idx = [i for i, e in enumerate(stored) if not e[5]]
+        if host_idx:
+            crcs = crc32c_batch([stored[i][1] for i in host_idx])
+            have_crcs = {i: int(c) for i, c in zip(host_idx, crcs)}
+        for i, e in enumerate(stored):
+            if e[5]:
+                from ..ops.crc32c_batch import crc32c_resident
+                have_crcs[i] = crc32c_resident(e[1])
+        vers = {e[4] for e in stored}
+        lens = {len(e[1]) for e in stored}
+        fast_ok = (len(stored) == n_acting and len(vers) == 1
+                   and len(lens) == 1)
+        if fast_ok:
+            for i, (shard, raw, label, crc, over, _) in \
+                    enumerate(stored):
+                if label is None or int(label) != shard \
+                        or crc is None \
+                        or int(crc) != have_crcs[i]:
+                    fast_ok = False
+                    break
+        if fast_ok:
+            DATAPATH_PERF.inc("scrub_fast_verifies")
+            continue
+        # slow path: reconstruct, re-encode, byte-compare
+        bufs, size, ver = await backend._gather_shards(
+            oid, need_shards=set(range(backend.k)))
+        if not bufs:
+            continue
+        logical = await backend.sinfo.reconstruct_logical_async(
+            backend.codec, bufs, batcher=backend.batcher)
+        pad = backend.sinfo.logical_to_next_stripe_offset(size)
+        canonical = await backend.sinfo.encode_async(
+            backend.codec, logical[:pad].ljust(pad, b"\0"),
+            batcher=backend.batcher)
         bad_shards: list[int] = []
         bad_tags: list[int] = []
-        for (shard, raw, label, crc), have in zip(stored, have_crcs):
+        for i, (shard, raw, label, crc, over, _) in enumerate(stored):
+            raw = bytes(raw)
             want = canonical[shard].tobytes()
             if raw != want:
                 bad_shards.append(shard)
             elif (label is not None and int(label) != shard) or \
                     not shard_crc_matches(raw, crc,
-                                          precomputed=int(have)):
+                                          precomputed=have_crcs[i]):
                 bad_tags.append(shard)
         if bad_shards or bad_tags:
             res.inconsistent[oid] = {"bad_shards": bad_shards,

@@ -1,0 +1,19 @@
+"""BAD: a config read two calls deep inside the launch loop.
+
+`CodecBatcher._run_batch` is a launch-loop entry point; the
+`self.config.get` lives in a helper it calls, so only the
+interprocedural closure can see it -- and it re-reads a knob per
+batch that the snapshot discipline says is read once at construction.
+"""
+
+
+class CodecBatcher:
+    def __init__(self, config):
+        self.config = config
+
+    def _run_batch(self, grp, reason):
+        cap = self._cap()
+        return grp[:cap]
+
+    def _cap(self):
+        return int(self.config.get("osd_ec_batch_max", 64))

@@ -1,0 +1,24 @@
+"""GOOD: every knob is snapshot at construction; the launch loop only
+touches the closed-over values.  Config reads outside the hot-path
+closure (a from_config constructor, an unrelated helper) are fine.
+"""
+
+
+class CodecBatcher:
+    def __init__(self, config):
+        # construction-time snapshot: the one blessed read site
+        self._max_batch = int(config.get("osd_ec_batch_max", 64))
+
+    @classmethod
+    def from_config(cls, conf):
+        if not conf.get("osd_ec_batch_enabled", True):
+            return None
+        return cls(conf)
+
+    def _run_batch(self, grp, reason):
+        return grp[:self._max_batch]
+
+
+def unrelated_admin_handler(config):
+    # not reachable from any launch-loop root: reads are fine here
+    return config.get("debug_osd", 1)

@@ -163,6 +163,37 @@ def test_osd_path_mesh_smoke_gates_hold():
     assert cluster["n_devices"] == 8
 
 
+def test_datapath_smoke_gates_hold():
+    """bench.py --datapath --smoke is the tier-1 tripwire for the
+    device-resident shard data path: cached and host-round-trip drives
+    must be byte-identical, the cached steady phases (read-verify /
+    scrub / degraded-read) must hit the cache and move ZERO shard
+    bytes through the store, and no scalar CRC call may appear on the
+    batched paths."""
+    import json
+    import os
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--datapath", "--smoke"],
+        capture_output=True, text=True, cwd="/root/repo", env=env,
+        timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    res = json.loads(r.stdout.strip().splitlines()[-1])
+    assert res["metric"] == "datapath_write_scrub_degraded_GiBps"
+    assert res["parity"] == "ok"
+    assert res["value"] > 0
+    assert res["cache_hits"] > 0
+    assert res["steady_host_bytes_read"] == 0
+    assert res["steady_host_reads"] == 0
+    assert res["scalar_calls_on_batched_paths"] == 0
+    assert res["host_bytes_avoided"] > 0
+    # the cached spine must beat the host round trip even at smoke
+    # scale (the >=5x acceptance bar applies to the full artifact)
+    assert res["vs_baseline"] > 1.0
+
+
 def test_cluster_smoke_exits_zero_with_no_failed_ops():
     """bench.py --cluster --smoke is the tier-1 tripwire for the
     traffic harness: a small deterministic swarm + OSD kill/revive

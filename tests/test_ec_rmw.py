@@ -212,8 +212,11 @@ def test_docstring_matches_rmw_write_amplification():
     was future work and every write rewrote the stripe set; RMW with
     ranged sub-writes landed long ago.  Pin BOTH: the prose must state
     the O(touched stripes) behavior, and the data path must honor it
-    with EXACT per-shard byte accounting (one chunk per touched stripe
-    per remote shard, not the whole object)."""
+    with EXACT per-shard byte accounting.  With the delta-RMW parity
+    path, a write inside ONE data chunk ships payload ONLY to the
+    changed data shard and the parity shard(s); unchanged data shards
+    get a version-stamp-only sub-write (zero payload bytes) -- the
+    pre-delta pipeline shipped every shard its chunk."""
     from ceph_tpu.osd.backend import ECBackend
     doc = ECBackend.__doc__
     assert "future work" not in doc
@@ -227,15 +230,79 @@ def test_docstring_matches_rmw_write_amplification():
                 0, 256, 10 * 8192, dtype=np.uint8).tobytes()
             await c.osd_op("ecpool", "amp", [
                 {"op": "writefull", "data": big}])
-            pgid, _, _ = c.target_for("ecpool", "amp")
+            pgid, primary, _ = c.target_for("ecpool", "amp")
+            posd = next(o for o in c.osds
+                        if pgid in o.pgs and o.pgs[pgid].is_primary())
+            acting = posd.pgs[pgid].acting
             counts = _spy_subop_bytes(c, pgid)
-            # overwrite entirely inside stripe 4: exactly ONE stripe
-            # touched -> each of the 2 remote shards gets exactly one
-            # 4096-byte chunk
+            # overwrite entirely inside data chunk 0 of stripe 4:
+            # exactly ONE stripe touched, ONE data chunk changed ->
+            # payload goes only to shard 0 (the changed chunk) and
+            # shard 2 (parity); a remote shard 1 gets a zero-payload
+            # version stamp
             await c.osd_op("ecpool", "amp", [
                 {"op": "write", "off": 4 * 8192 + 100, "data": b"Q" * 500}])
+            # every remote still gets its sub-write (version stamps
+            # keep the stale-shard rejection sound)...
             assert counts["calls"] == 2, counts
-            assert counts["bytes"] == 2 * 4096, counts
+            # ...but only changed-data + parity shards carry bytes
+            expect = sum(4096 for shard, osd in enumerate(acting)
+                         if osd != posd.whoami and shard in (0, 2))
+            assert counts["bytes"] == expect, (counts, acting)
+            assert counts["bytes"] <= 2 * 4096
+            # the delta path actually ran (one rmw launch, no full
+            # re-encode of the touched run)
+            perf = posd.codec_batcher.perf
+            assert perf.get("rmw_delta_runs") >= 1
+            assert perf.get("rmw_launches") >= 1
+            assert perf.get("rmw_full_runs") == 0
+            # and the bytes are right: full read-back matches
+            shadow = bytearray(big)
+            shadow[4 * 8192 + 100:4 * 8192 + 600] = b"Q" * 500
+            reply = await c.osd_op("ecpool", "amp", [
+                {"op": "read", "off": 0, "len": None}])
+            _, data = read_result(reply)
+            assert data == bytes(shadow)
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_rmw_delta_parity_survives_degraded_read():
+    """The delta-updated parity must be byte-identical to a full
+    re-encode: kill a DATA shard holder after delta writes and decode
+    the object from the surviving shard + parity."""
+    async def main():
+        c = await _ec_cluster()
+        try:
+            rng = np.random.default_rng(21)
+            base = rng.integers(0, 256, 6 * 8192,
+                                dtype=np.uint8).tobytes()
+            await c.osd_op("ecpool", "dp", [
+                {"op": "writefull", "data": base}])
+            shadow = bytearray(base)
+            # several delta writes, including one spanning chunks
+            for off, data in ((100, b"x" * 300), (8192 + 4000, b"y" * 600),
+                              (3 * 8192 + 50, b"z" * 4090)):
+                await c.osd_op("ecpool", "dp", [
+                    {"op": "write", "off": off, "data": data}])
+                shadow[off:off + len(data)] = data
+            pgid, primary, up = c.target_for("ecpool", "dp")
+            posd = next(o for o in c.osds
+                        if pgid in o.pgs and o.pgs[pgid].is_primary())
+            assert posd.codec_batcher.perf.get("rmw_delta_runs") >= 3
+            victim = next(o for o in c.osds
+                          if o.whoami in up and o.whoami != primary)
+            await victim.stop()
+            c.osds = [o for o in c.osds if o.whoami != victim.whoami]
+            for _ in range(100):
+                if not c.mon.osdmap.is_up(victim.whoami):
+                    break
+                await asyncio.sleep(0.2)
+            reply = await c.osd_op("ecpool", "dp", [
+                {"op": "read", "off": 0, "len": None}])
+            r, data = read_result(reply)
+            assert r.get("ok") and data == bytes(shadow)
         finally:
             await c.stop()
     run(main())

@@ -49,6 +49,8 @@ RULE_FIXTURES = {
                    "osd/lock_order_good.py"),
     "counter-coverage": ("counter_coverage_bad.py",
                          "counter_coverage_good.py"),
+    "hot-path-config-read": ("hot_config_bad.py",
+                             "hot_config_good.py"),
 }
 
 

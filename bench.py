@@ -1092,6 +1092,114 @@ def _mesh_gates(smoke: bool) -> dict:
     return gates
 
 
+def _xor_sched_rows(smoke: bool) -> dict:
+    """The XOR-schedule compiler's bench rows (ops/xor_schedule.py):
+
+    * static: XOR-term reduction of the CSE-minimized schedule vs the
+      naive row-by-row XOR on the Cauchy k=8,m=3 bitmatrix (the
+      ISSUE/ROADMAP headline; acceptance floor 30%);
+    * bitmatrix host row: wall-clock of the scheduled host executor vs
+      the naive ``xor_matmul`` on the same plane batch (the
+      BitMatrixCodec data path, min-of-N so the comparison is about
+      work, not scheduler noise);
+    * batched XLA row: the scheduled (B, k, L) kernel family vs the
+      dense bit-matmul on the current backend (the CodecBatcher path).
+    """
+    import numpy as np
+    from ceph_tpu.gf.gf2w import (cauchy_improve_coding_matrix,
+                                  cauchy_original_coding_matrix,
+                                  matrix_to_bitmatrix, xor_matmul)
+    from ceph_tpu.gf import gen_rs_matrix, gf_matmul
+    from ceph_tpu.ops import gf2kernels as G
+    from ceph_tpu.ops import xor_schedule as XS
+
+    k, m, w = 8, 3, 8
+    bm = matrix_to_bitmatrix(
+        cauchy_improve_coding_matrix(
+            cauchy_original_coding_matrix(k, m, w), k, m, w), k, m, w)
+    sched = XS.schedule_for(bm)
+    rows: dict = {
+        "matrix": f"cauchy_good k={k} m={m} w={w}",
+        "naive_xor_terms": sched.naive_terms,
+        "sched_xor_terms": sched.n_terms,
+        "reduction_pct": round(100 * sched.reduction, 1),
+        "peak_registers": sched.peak_registers,
+    }
+    log(f"xor-schedule: cauchy k=8,m=3 {sched.naive_terms} -> "
+        f"{sched.n_terms} terms ({rows['reduction_pct']}% reduction, "
+        f"peak {sched.peak_registers} regs)")
+
+    def best_of(fn, reps: int) -> float:
+        fn()                                 # warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    rng = np.random.default_rng(0)
+    # above the HOST_MIN_LANE crossover even in smoke: the row exists
+    # to show the scheduled engine winning where the cost model would
+    # actually deploy it
+    n = 32768 if smoke else 131072
+    planes = rng.integers(0, 256, size=(k * w, n), dtype=np.uint8)
+    reps = 5 if smoke else 9
+    dt_naive = best_of(lambda: xor_matmul(bm, planes), reps)
+    dt_sched = best_of(lambda: XS.apply_host(sched, planes), reps)
+    assert np.array_equal(XS.apply_host(sched, planes),
+                          xor_matmul(bm, planes))
+    rows["bitmatrix_host"] = {
+        "planes_bytes": int(planes.size),
+        "naive_ms": round(dt_naive * 1000, 3),
+        "sched_ms": round(dt_sched * 1000, 3),
+        "speedup": round(dt_naive / dt_sched, 2),
+    }
+    log(f"xor-schedule host row: naive {dt_naive * 1000:.2f} ms vs "
+        f"scheduled {dt_sched * 1000:.2f} ms "
+        f"({dt_naive / dt_sched:.2f}x)")
+
+    import jax
+    import jax.numpy as jnp
+    gen = gen_rs_matrix(k + m, k)
+    mat = np.ascontiguousarray(gen[k:], np.uint8)
+    b, lane = (8, 4096) if smoke else (64, 65536)
+    data = rng.integers(0, 256, size=(b, k, lane), dtype=np.uint8)
+    xd = jnp.asarray(data)
+    rs_sched = XS.schedule_for(G.bitmatrix_i8(mat))
+
+    def run_dense():
+        os.environ["CEPH_TPU_XOR_SCHED"] = "0"
+        try:
+            G.gf_matmul_batch_device(mat, xd).block_until_ready()
+        finally:
+            os.environ.pop("CEPH_TPU_XOR_SCHED", None)
+
+    def run_sched():
+        out = XS.sched_matmul_batch_device(rs_sched, mat, xd, b, k,
+                                           lane)
+        if out is None:
+            raise RuntimeError("scheduled kernel rejected")
+        out.block_until_ready()
+
+    dt_dense = best_of(run_dense, 3 if smoke else 5)
+    dt_xla = best_of(run_sched, 3 if smoke else 5)
+    got = np.asarray(XS.sched_matmul_batch_device(rs_sched, mat, xd,
+                                                  b, k, lane))
+    assert np.array_equal(got[0], gf_matmul(mat, data[0]))
+    rows["batched_xla"] = {
+        "backend": jax.default_backend(),
+        "shape": [b, k, lane],
+        "dense_ms": round(dt_dense * 1000, 3),
+        "sched_ms": round(dt_xla * 1000, 3),
+        "speedup": round(dt_dense / dt_xla, 2),
+    }
+    log(f"xor-schedule XLA row ({jax.default_backend()}): dense "
+        f"{dt_dense * 1000:.2f} ms vs scheduled {dt_xla * 1000:.2f} "
+        f"ms ({dt_dense / dt_xla:.2f}x)")
+    return rows
+
+
 def _osd_path_mode(deadline: float, mesh: bool = False,
                    smoke: bool = False) -> int:
     """--osd-path: drive the OSD DATA PATH — concurrent client EC
@@ -1125,6 +1233,12 @@ def _osd_path_mode(deadline: float, mesh: bool = False,
     log(f"osd path: {res['osd_path_GiBps']} GiB/s, "
         f"{res['stripes_per_launch']} stripes/launch "
         f"({res['batches']} launches)")
+    try:
+        res["xor_schedule"] = _xor_sched_rows(smoke)
+    except Exception as e:
+        log(f"xor-schedule rows failed: {type(e).__name__}: "
+            f"{str(e)[:120]}")
+        res["xor_schedule"] = {"error": str(e)[:120]}
     if gates is not None:
         gates["cluster_launches_per_batch"] = \
             res.get("mesh", {}).get("launches_per_batch", 0.0)
@@ -1138,9 +1252,26 @@ def _osd_path_mode(deadline: float, mesh: bool = False,
         **res,
     })
     emit()
-    if gates is None:
-        return 0
     rc = 0
+    xs = res.get("xor_schedule", {})
+    if smoke:
+        # the XOR-schedule acceptance gates: >=30% term reduction on
+        # the Cauchy k=8,m=3 bitmatrix, a CPU wall-clock win on the
+        # bitmatrix host row, zero scheduled-kernel fallbacks in the
+        # cluster drive
+        if xs.get("reduction_pct", 0.0) < 30.0:
+            log("ERROR: xor-schedule term reduction below the 30% "
+                "floor")
+            rc = 1
+        if xs.get("bitmatrix_host", {}).get("speedup", 0.0) <= 1.0:
+            log("ERROR: scheduled bitmatrix row lost to the naive "
+                "XOR on CPU")
+            rc = 1
+        if res.get("xor_sched", {}).get("fallbacks", 0):
+            log("ERROR: scheduled kernels fell back mid-drive")
+            rc = 1
+    if gates is None:
+        return rc
     if gates["launches_per_batch"] != 1.0 or gates["mesh_fallbacks"]:
         log("ERROR: mesh gate demands exactly one device launch per "
             "coalesced batch")

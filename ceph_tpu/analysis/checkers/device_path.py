@@ -60,6 +60,13 @@ ROOTS = (
     "crc32c_device_chunks",
     "ErasureCodeTpu.encode_batch_crc",
     "JaxBackend.matmul_batch_crc",
+    # the XOR-schedule compiler's launch entry points
+    # (ops/xor_schedule.py): the batched scheduled kernel family and
+    # the host scheduled executor the BitMatrixCodec data path rides
+    "sched_matmul_batch_device",
+    "scheduled_xor_matmul",
+    "MeshCodec._apply_sched",
+    "MeshCodec._rmw_sched",
 )
 
 # ambiguity budget: a fuzzy call edge that could hit more than this

@@ -41,6 +41,18 @@ from ..registry import ProjectChecker, register
 
 _JIT_LEAVES = {"jit", "pjit"}
 
+# Launch wrappers whose donation the AST cannot see (the jit carrying
+# donate_argnums comes out of a cached compile factory, so no literal
+# reaches the call site): seeded into the donor fixpoint by name, the
+# way device_path.ROOTS anchors reachability.  Positions are call-arg
+# indices after self.  The scheduled-kernel mesh launches
+# (parallel/mesh_codec.py) consume their donated device buffers
+# through exactly these entry points.
+ROOTS = (
+    ("MeshCodec._sched_launch", (1,)),
+    ("MeshCodec._sched_rmw_launch", (1, 2)),
+)
+
 
 def _donated_positions(call: ast.Call) -> tuple[int, ...] | None:
     """Literal donate_argnums of a jit/pjit call, or None."""
@@ -121,6 +133,14 @@ class DonatedBufferAliasing(ProjectChecker):
                             off = 1 if fi.cls else 0
                             donors[("fn", fi.qualname)] = tuple(
                                 p - off for p in pos if p - off >= 0)
+        # declared donor ROOTS: launch wrappers around factory-made
+        # donating executables
+        for spec, pos in ROOTS:
+            for qual in graph.lookup(spec):
+                fi = graph.functions.get(qual)
+                if fi is not None and fi.path in in_scope:
+                    merged = set(donors.get(("fn", qual), ())) | set(pos)
+                    donors[("fn", qual)] = tuple(sorted(merged))
 
         # interprocedural fixpoint: forwarding a parameter into a
         # donated position makes the forwarder a donor of that param

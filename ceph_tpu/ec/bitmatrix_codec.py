@@ -20,7 +20,8 @@ from typing import Mapping
 
 import numpy as np
 
-from ..gf.gf2w import gf2_invert, xor_matmul
+from ..gf.gf2w import gf2_invert
+from ..ops.xor_schedule import scheduled_xor_matmul, warm_schedule
 from .base import ErasureCode
 
 
@@ -37,7 +38,7 @@ class BitMatrixCodec(ErasureCode):
         self.w = 8
         self.packetsize = 8
         self.bitmatrix: np.ndarray | None = None
-        self._inv_cache: OrderedDict[str, tuple] = OrderedDict()
+        self._inv_cache: OrderedDict[tuple, np.ndarray] = OrderedDict()
 
     # -- geometry -----------------------------------------------------------
     def get_chunk_count(self) -> int:
@@ -86,7 +87,10 @@ class BitMatrixCodec(ErasureCode):
                 f"chunk size {csize} not a multiple of w*packetsize="
                 f"{self.w * self.packetsize}")
         planes = self._planes(data)
-        coding = xor_matmul(self.bitmatrix, planes)
+        # the CSE-minimized XOR schedule (ops/xor_schedule.py): the
+        # encode matrix is hot for the codec's lifetime, so compile on
+        # first use; byte-identical to the naive row-by-row XOR
+        coding = scheduled_xor_matmul(self.bitmatrix, planes)
         out = self._unplanes(coding, m, csize)
         for r in range(m):
             chunks[self.chunk_index(k + r)][:] = out[r]
@@ -102,11 +106,46 @@ class BitMatrixCodec(ErasureCode):
         return self.bitmatrix[(chunk - self.k) * self.w:
                               (chunk - self.k + 1) * self.w]
 
+    def _repair_matrix(self, sel: tuple[int, ...],
+                       erasures: tuple[int, ...]) -> np.ndarray:
+        """ONE (len(erasures)*w, k*w) GF(2) matrix mapping the
+        surviving planes directly to every missing chunk's planes:
+        data erasure e contributes rows inv[e*w:(e+1)*w], coding
+        erasure e contributes bitmatrix_rows(e) @ inv (mod 2) -- so
+        repair is a single launch instead of one per lost chunk.
+        Cached per (survivor set, erasure pattern) and its XOR
+        schedule warmed at build time, so repeated repairs ride the
+        scheduled kernel without paying a compile on the read path."""
+        key = (",".join(map(str, sel)), ",".join(map(str, erasures)))
+        entry = self._inv_cache.get(key)
+        if entry is not None:
+            self._inv_cache.move_to_end(key)   # LRU, not FIFO
+            return entry
+        w = self.w
+        s = np.concatenate([self._generator_rows(c) for c in sel])
+        inv = gf2_invert(s)               # raises if not decodable
+        rows = []
+        for e in erasures:
+            if e < self.k:
+                rows.append(inv[e * w:(e + 1) * w])
+            else:
+                gen = self.bitmatrix[(e - self.k) * w:
+                                     (e - self.k + 1) * w]
+                rows.append((gen.astype(np.uint32)
+                             @ inv.astype(np.uint32)) & 1)
+        repair = np.ascontiguousarray(
+            np.concatenate(rows).astype(np.uint8))
+        warm_schedule(repair)
+        self._inv_cache[key] = repair
+        while len(self._inv_cache) > 128:
+            self._inv_cache.popitem(last=False)
+        return repair
+
     def decode_chunks(
         self, want_to_read: set[int], chunks: Mapping[int, np.ndarray],
         decoded: dict[int, np.ndarray],
     ) -> None:
-        k, m, w = self.k, self.m, self.w
+        k, m = self.k, self.m
         erasures = [i for i in range(k + m) if i not in chunks]
         if not erasures:
             return
@@ -114,28 +153,14 @@ class BitMatrixCodec(ErasureCode):
             raise IOError(f"{len(erasures)} erasures exceed m={m}")
         available = sorted(set(range(k + m)) - set(erasures))
         sel = available[:k]
-        key = ",".join(map(str, sel))
-        entry = self._inv_cache.get(key)
-        if entry is None:
-            s = np.concatenate([self._generator_rows(c) for c in sel])
-            inv = gf2_invert(s)           # raises if not decodable
-            self._inv_cache[key] = inv
-            while len(self._inv_cache) > 128:
-                self._inv_cache.popitem(last=False)
-        else:
-            inv = entry
-            self._inv_cache.move_to_end(key)   # LRU, not FIFO
+        repair = self._repair_matrix(tuple(sel), tuple(erasures))
         csize = len(next(iter(decoded.values())))
         src = np.stack([decoded[c] for c in sel])
-        data_planes = xor_matmul(inv, self._planes(src))
-        data = self._unplanes(data_planes, k, csize)
-        for e in erasures:
-            if e < k:
-                decoded[e][:] = data[e]
-        coding_erased = [e for e in erasures if e >= k]
-        if coding_erased:
-            planes = self._planes(data)
-            for e in coding_erased:
-                rows = self.bitmatrix[(e - k) * w:(e - k + 1) * w]
-                decoded[e][:] = self._unplanes(
-                    xor_matmul(rows, planes), 1, csize)[0]
+        # every missing chunk (data AND coding) from one launch; the
+        # schedule was warmed when the repair matrix was built, so
+        # the read path never compiles (allow_compile=False)
+        planes = scheduled_xor_matmul(repair, self._planes(src),
+                                      allow_compile=False)
+        out = self._unplanes(planes, len(erasures), csize)
+        for i, e in enumerate(erasures):
+            decoded[e][:] = out[i]

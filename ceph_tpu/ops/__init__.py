@@ -8,12 +8,19 @@ GF kernel name pulls ``gf2kernels``.
 
 _GF_EXPORTS = ("gf_matmul_device", "gf_matmul_batch_device",
                "bitmatrix_i8", "clear_kernel_cache")
+# the XOR-schedule compiler is numpy-only at import time (jax loads
+# lazily inside its device executors), so these stay jax-free too
+_XS_EXPORTS = ("compile_schedule", "schedule_for",
+               "scheduled_xor_matmul")
 
-__all__ = list(_GF_EXPORTS)
+__all__ = list(_GF_EXPORTS) + list(_XS_EXPORTS)
 
 
 def __getattr__(name):
     if name in _GF_EXPORTS:
         from . import gf2kernels
         return getattr(gf2kernels, name)
+    if name in _XS_EXPORTS:
+        from . import xor_schedule
+        return getattr(xor_schedule, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

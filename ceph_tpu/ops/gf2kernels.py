@@ -353,6 +353,8 @@ def clear_kernel_cache() -> None:
                _bitmatrix_device, _tuned_cfgs):
         getattr(fn, "cache_clear", lambda: None)()
     _g2_health.clear()
+    from .xor_schedule import clear_schedule_cache
+    clear_schedule_cache()
 
 
 def _want_pallas() -> bool:
@@ -510,7 +512,13 @@ def gf_matmul_batch_device(matrix: np.ndarray, data, *, out_np: bool = False):
     b, k, l = data.shape
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     xd = jnp.asarray(data, dtype=jnp.uint8)
-    out = _try_g2(matrix, xd, b, k, l)
+    # CSE-minimized XOR schedule (ops/xor_schedule.py) when the
+    # cost model picks it for this (matrix, shape) family; parity-
+    # gated with transparent fallback to the dense ladder below
+    from .xor_schedule import maybe_batch_scheduled
+    out = maybe_batch_scheduled(matrix, xd, b, k, l)
+    if out is None:
+        out = _try_g2(matrix, xd, b, k, l)
     if out is None:
         w = bitmatrix_device(matrix)
         fn = _compiled_batch(w.shape[0], k, b, l, _want_pallas())

@@ -343,6 +343,14 @@ class CodecBatcher:
                     row += n
         want_crc = any(w for _, _, w, _ in items)
         crcs = None
+        # scheduled-engine observability: the XOR-schedule compiler
+        # (ops/xor_schedule.py) counts process-wide; sampling the
+        # delta around THIS launch keeps the ec_batch counters live
+        # on every scheduled launch (the perf-coherence contract)
+        xor_stats0 = None
+        if self.perf is not None:
+            from ..ops.xor_schedule import STATS as XOR_STATS
+            xor_stats0 = XOR_STATS.snapshot()
         try:
             out = None
             if mesh is not None:
@@ -424,6 +432,13 @@ class CodecBatcher:
             self.perf.inc("pad_waste_bytes", b * k * lane - payload)
             self.perf.inc(f"flush_{reason}")
             self.perf.hist_sample("stripes_per_batch", total)
+            if xor_stats0 is not None:
+                from ..ops.xor_schedule import STATS as XOR_STATS
+                l1, f1, t1 = XOR_STATS.snapshot()
+                l0, f0, t0 = xor_stats0
+                self.perf.inc("xor_sched_launches", l1 - l0)
+                self.perf.inc("xor_sched_fallbacks", f1 - f0)
+                self.perf.inc("xor_terms_saved", t1 - t0)
 
     @staticmethod
     def _fused_crc_ok() -> bool:

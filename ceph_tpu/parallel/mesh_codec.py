@@ -120,6 +120,65 @@ def _compiled_apply(mesh: Mesh, b: int, k: int, lane: int,
 
 
 @functools.lru_cache(maxsize=256)
+def _compiled_apply_sched(mesh: Mesh, digest: str, b: int, k: int,
+                          lane: int, with_crc: bool, donate: bool):
+    """The scheduled twin of ``_compiled_apply``: the CSE-minimized
+    XOR schedule (ops/xor_schedule.py, looked up by matrix digest) is
+    BAKED into the program instead of taking W as an operand, so the
+    executable cache keys on the digest.  Same sharding, same fused
+    CRC side-path, same donation contract: the stripe buffer (arg 0)
+    is donated -- consumed by the launch, never read again."""
+    from ..ops.xor_schedule import apply_bits_traced, registered
+    sched = registered(digest)
+
+    def block(chunks):
+        bl, kk, ll = chunks.shape
+        flat = chunks.transpose(1, 0, 2).reshape(kk, bl * ll)
+        rows = apply_bits_traced(sched, flat)
+        return rows.reshape(-1, bl, ll).transpose(1, 0, 2)
+
+    sharded = shard_map(
+        block, mesh=mesh,
+        in_specs=(P("stripe", None, None),),
+        out_specs=P("stripe", None, None))
+    if not with_crc:
+        return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    def fn(data):
+        from ..ops.crc32c_batch import crc32c_chunks_traced
+        parity = sharded(data)
+        crcs = jnp.concatenate([crc32c_chunks_traced(data),
+                                crc32c_chunks_traced(parity)], axis=1)
+        return parity, crcs
+
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_rmw_sched(mesh: Mesh, digest: str, b: int, m: int,
+                        k: int, lane: int, donate: bool):
+    """Scheduled RMW: new_parity = old_parity XOR schedule(delta) in
+    one launch, old-parity donated and ALIASED in place exactly like
+    the dense ``_compiled_rmw`` (shapes match)."""
+    from ..ops.xor_schedule import apply_bits_traced, registered
+    sched = registered(digest)
+
+    def block(oldp, delta):
+        bl, kk, ll = delta.shape
+        flat = delta.transpose(1, 0, 2).reshape(kk, bl * ll)
+        rows = apply_bits_traced(sched, flat)
+        return jnp.bitwise_xor(
+            oldp, rows.reshape(-1, bl, ll).transpose(1, 0, 2))
+
+    sharded = shard_map(
+        block, mesh=mesh,
+        in_specs=(P("stripe", None, None), P("stripe", None, None)),
+        out_specs=P("stripe", None, None))
+    return jax.jit(sharded,
+                   donate_argnums=(0, 1) if donate else ())
+
+
+@functools.lru_cache(maxsize=256)
 def _compiled_rmw(mesh: Mesh, b: int, m: int, k: int, lane: int,
                   donate: bool):
     """Delta-encoded partial-stripe RMW in one launch: new_parity =
@@ -153,6 +212,7 @@ def _decode_matrix_cached(mat_bytes: bytes, rows: int, k_total: int,
 
 def clear_mesh_cache() -> None:
     for fn in (_shared_mesh, _w_device, _compiled_apply, _compiled_rmw,
+               _compiled_apply_sched, _compiled_rmw_sched,
                _decode_matrix_cached):
         fn.cache_clear()
 
@@ -210,11 +270,66 @@ class MeshCodec:
         return jax.device_put(np.ascontiguousarray(arr, np.uint8),
                               self._data_sharding)
 
+    def _sched_launch(self, fn, dev_batch):
+        """``dev_batch`` is DONATED to the compiled scheduled launch:
+        the launch owns it; never read it after this call (the
+        donated-buffer-aliasing ROOTS name this entry point)."""
+        return fn(dev_batch)
+
+    def _sched_rmw_launch(self, fn, dev_old, dev_delta):
+        """Both device buffers are DONATED (old parity aliases the
+        output in place); never read either after this call."""
+        return fn(dev_old, dev_delta)
+
+    def _apply_sched(self, matrix: np.ndarray, batch: np.ndarray,
+                     with_crc: bool):
+        """The scheduled engine for this batch, or None (dense wins
+        per the cost model, or the scheduled launch failed/parity-
+        rejected and the dense path must serve)."""
+        from ..ops import xor_schedule as XS
+        b, k, lane = batch.shape
+        sched = XS.want_scheduled(bitmatrix_i8(matrix), lane,
+                                  jax.default_backend())
+        if sched is None:
+            return None
+        key = (sched.digest, "mesh", b, k, lane)
+        if XS._sched_health.get(key) is False:
+            return None
+        try:
+            fn = _compiled_apply_sched(self.mesh, sched.digest, b, k,
+                                       lane, with_crc, self.donate)
+            out = self._sched_launch(fn, self._put(batch))
+            if key not in XS._sched_health:
+                # one-time byte-parity gate vs the host oracle on a
+                # small slice (batch is the HOST copy: still readable)
+                from ..gf import gf_matmul
+                parity = out[0] if with_crc else out
+                ncheck = min(256, lane)
+                # lint: disable=device-path-host-sync -- one-time parity gate vs the host oracle, bounded slice
+                got = np.asarray(parity[:1, :, :ncheck])
+                if not np.array_equal(
+                        got[0], gf_matmul(matrix,
+                                          batch[0, :, :ncheck])):
+                    XS._sched_health[key] = False
+                    XS.STATS.note_fallback()
+                    return None
+                XS._sched_health[key] = True
+            self._count(b)
+            XS.STATS.note_launch(sched)
+            return out
+        except Exception:
+            XS._sched_health[key] = False
+            XS.STATS.note_fallback()
+            return None
+
     def _apply(self, matrix: np.ndarray, batch: np.ndarray,
                with_crc: bool):
         b, k, lane = batch.shape
         assert b % self.n_devices == 0, (b, self.n_devices)
         matrix = np.ascontiguousarray(matrix, np.uint8)
+        out = self._apply_sched(matrix, batch, with_crc)
+        if out is not None:
+            return out
         w = _w_device(self.mesh, matrix.tobytes(), *matrix.shape)
         fn = _compiled_apply(self.mesh, b, k, lane, with_crc,
                              self.donate)
@@ -266,11 +381,51 @@ class MeshCodec:
         assert b % self.n_devices == 0, (b, self.n_devices)
         mat = np.ascontiguousarray(codec.encode_matrix[codec.k:],
                                    np.uint8)
-        w = _w_device(self.mesh, mat.tobytes(), *mat.shape)
-        fn = _compiled_rmw(self.mesh, b, m, k, lane, self.donate)
-        out = fn(w, self._put(old_parity), self._put(delta))
-        self._count(b)
+        out = self._rmw_sched(mat, old_parity, delta)
+        if out is None:
+            w = _w_device(self.mesh, mat.tobytes(), *mat.shape)
+            fn = _compiled_rmw(self.mesh, b, m, k, lane, self.donate)
+            out = fn(w, self._put(old_parity), self._put(delta))
+            self._count(b)
         if self.perf is not None:
             self.perf.inc("mesh_rmw_launches")
         # lint: disable=device-path-host-sync -- the single post-launch materialization
         return np.asarray(out)
+
+    def _rmw_sched(self, mat: np.ndarray, old_parity: np.ndarray,
+                   delta: np.ndarray):
+        """Scheduled RMW launch, or None (dense serves)."""
+        from ..ops import xor_schedule as XS
+        b, k, lane = delta.shape
+        m = old_parity.shape[1]
+        sched = XS.want_scheduled(bitmatrix_i8(mat), lane,
+                                  jax.default_backend())
+        if sched is None:
+            return None
+        key = (sched.digest, "mesh_rmw", b, k, lane)
+        if XS._sched_health.get(key) is False:
+            return None
+        try:
+            fn = _compiled_rmw_sched(self.mesh, sched.digest, b, m, k,
+                                     lane, self.donate)
+            out = self._sched_rmw_launch(fn, self._put(old_parity),
+                                         self._put(delta))
+            if key not in XS._sched_health:
+                from ..gf import gf_matmul
+                ncheck = min(256, lane)
+                # lint: disable=device-path-host-sync -- one-time parity gate vs the host oracle, bounded slice
+                got = np.asarray(out[:1, :, :ncheck])
+                want = old_parity[0, :, :ncheck] ^ gf_matmul(
+                    mat, delta[0, :, :ncheck])
+                if not np.array_equal(got[0], want):
+                    XS._sched_health[key] = False
+                    XS.STATS.note_fallback()
+                    return None
+                XS._sched_health[key] = True
+            self._count(b)
+            XS.STATS.note_launch(sched)
+            return out
+        except Exception:
+            XS._sched_health[key] = False
+            XS.STATS.note_fallback()
+            return None

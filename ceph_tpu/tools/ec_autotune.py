@@ -1,16 +1,27 @@
-"""Autotune the MXU-packed GF(2) kernel family on real hardware.
+"""Autotune the GF(2) kernel families on the live backend.
 
-Sweeps the (unpack, mm dtype, pack, tile, group) space of
-ceph_tpu/ops/gf2kernels._make_pallas_batch_fn_gN on a device-resident
-stripe batch, parity-gates every candidate against the host oracle,
-and writes the winner per k to ceph_tpu/ops/gf2_tuned.json -- the
-config gf_matmul_batch_device serves by default from then on.
+Two sweeps, both parity-gated against the host oracle:
+
+  * the MXU-packed family: the (unpack, mm dtype, pack, tile, group)
+    space of ceph_tpu/ops/gf2kernels._make_pallas_batch_fn_gN on a
+    device-resident stripe batch (TPU only -- needs pallas);
+  * dense vs scheduled: the dense bit-matmul against the
+    CSE-minimized XOR schedule (ops/xor_schedule.py) per (k, m,
+    chunk), recording the winner under the "xor_sched" key of
+    ceph_tpu/ops/gf2_tuned.json -- the cost model
+    (xor_schedule.want_scheduled) serves it by default from then on.
 
 The reference tunes its SIMD technique per-CPU at plugin load
 (src/erasure-code/isa/ErasureCodeIsa.cc picks AVX2/AVX512 paths); this
-is the TPU equivalent, run once per hardware generation:
+is the accelerator equivalent, run once per hardware generation:
 
     python -m ceph_tpu.tools.ec_autotune --k 8 --m 3 --write
+
+``--cpu-smoke`` shrinks the shapes, skips the pallas sweep and runs
+the dense-vs-scheduled sweep on the CPU backend, so the sweep harness
+itself is exercised by tier-1 (tests/test_xor_schedule.py) instead of
+rotting as TPU-only dead code; pair it with ``--out`` to keep smoke
+winners out of the real tuned file.
 """
 
 from __future__ import annotations
@@ -103,6 +114,94 @@ def sweep(k: int, m: int, batch: int, chunk: int,
     return sorted(results, key=lambda r: -r["gibps"])
 
 
+def sweep_engines(k: int, m: int, batch: int, chunk: int,
+                  iters: int = 8) -> dict | None:
+    """Dense vs scheduled on one (k, m, batch, chunk) shape: time the
+    dense bit-matmul family against the CSE-minimized XOR schedule on
+    identical device-resident batches, byte-parity-gate both against
+    the host oracle, and return the winner record the cost model
+    consumes (None when the scheduled family cannot serve)."""
+    import os
+    from ..gf import gen_rs_matrix, gf_matmul
+    from ..ops import gf2kernels as G
+    from ..ops import xor_schedule as XS
+
+    gen = gen_rs_matrix(k + m, k)
+    mat = np.ascontiguousarray(gen[k:], np.uint8)
+    rng = np.random.default_rng(0)
+    xd = stage_batch(rng, batch, k, chunk)
+    sample = np.asarray(xd[:1, :, :512])
+    want = gf_matmul(mat, sample[0])
+
+    def timed(fn) -> tuple[float, np.ndarray]:
+        out = fn()
+        out.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters, \
+            np.asarray(out[:1, :, :512])
+
+    os.environ["CEPH_TPU_XOR_SCHED"] = "0"
+    try:
+        dt_dense, got_dense = timed(
+            lambda: G.gf_matmul_batch_device(mat, xd))
+    finally:
+        os.environ.pop("CEPH_TPU_XOR_SCHED", None)
+    if not np.array_equal(got_dense[0], want):
+        log("engine sweep: dense PARITY FAIL")
+        return None
+    sched = XS.schedule_for(G.bitmatrix_i8(mat))
+
+    def run_sched():
+        out = XS.sched_matmul_batch_device(sched, mat, xd, batch, k,
+                                           chunk)
+        if out is None:
+            raise RuntimeError("scheduled kernel rejected")
+        return out
+
+    try:
+        dt_sched, got_sched = timed(run_sched)
+    except Exception as e:
+        log(f"engine sweep: scheduled ERROR {type(e).__name__}: "
+            f"{str(e)[:100]}")
+        return None
+    if not np.array_equal(got_sched[0], want):
+        log("engine sweep: scheduled PARITY FAIL")
+        return None
+    gibps = lambda dt: batch * k * chunk / dt / 2**30  # noqa: E731
+    rec = {
+        "engine": "scheduled" if dt_sched < dt_dense else "dense",
+        "dense_gibps": round(gibps(dt_dense), 3),
+        "sched_gibps": round(gibps(dt_sched), 3),
+        "sched_terms": sched.n_terms,
+        "naive_terms": sched.naive_terms,
+        "reduction_pct": round(100 * sched.reduction, 1),
+    }
+    log(f"engine sweep k={k} m={m} batch={batch} chunk={chunk}: "
+        f"dense={rec['dense_gibps']} GiB/s sched={rec['sched_gibps']}"
+        f" GiB/s -> {rec['engine']} "
+        f"(xor terms {sched.n_terms}/{sched.naive_terms})")
+    return rec
+
+
+def _write_tuned(path: str, update: dict) -> None:
+    try:
+        with open(path) as f:
+            tuned = json.load(f)
+    except Exception:
+        tuned = {}
+    for key, val in update.items():
+        if isinstance(val, dict) and isinstance(tuned.get(key), dict):
+            tuned[key].update(val)
+        else:
+            tuned[key] = val
+    with open(path, "w") as f:
+        json.dump(tuned, f, indent=2, sort_keys=True)
+    log(f"wrote {path}: {sorted(update)}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--k", type=int, default=8)
@@ -112,32 +211,53 @@ def main(argv=None) -> int:
     ap.add_argument("--chunk", type=int, default=1 << 17)
     ap.add_argument("--budget-s", type=float, default=600.0)
     ap.add_argument("--write", action="store_true",
-                    help="persist the winner to gf2_tuned.json")
+                    help="persist the winners to the tuned file")
+    ap.add_argument("--out", default=None,
+                    help="tuned-file path (default: the live "
+                         "ceph_tpu/ops/gf2_tuned.json)")
+    ap.add_argument("--cpu-smoke", action="store_true",
+                    help="tier-1 harness mode: tiny shapes, skip the "
+                         "pallas sweep, engine sweep only")
     args = ap.parse_args(argv)
 
     import jax
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    if args.cpu_smoke:
+        args.batch = min(args.batch, 8)
+        args.chunk = min(args.chunk, 4096)
     args.batch = max(8, (args.batch // 8) * 8)
-    results = sweep(args.k, args.m, args.batch, args.chunk,
-                    args.budget_s)
-    if not results:
+
+    results = []
+    if not args.cpu_smoke:
+        results = sweep(args.k, args.m, args.batch, args.chunk,
+                        args.budget_s)
+        if not results:
+            log("no working pallas config found")
+    engines = sweep_engines(args.k, args.m, args.batch, args.chunk,
+                            iters=2 if args.cpu_smoke else 8)
+    if not results and engines is None:
         log("no working config found")
         return 1
-    best = results[0]
-    print(json.dumps({"k": args.k, "best": best,
-                      "top5": results[:5]}, indent=2))
+    report = {"k": args.k, "m": args.m, "chunk": args.chunk,
+              "xor_sched": engines}
+    if results:
+        report["best"] = results[0]
+        report["top5"] = results[:5]
+    print(json.dumps(report, indent=2))
     if args.write:
         from ..ops.gf2kernels import _TUNED_PATH
-        try:
-            with open(_TUNED_PATH) as f:
-                tuned = json.load(f)
-        except Exception:
-            tuned = {}
-        tuned[str(args.k)] = {kk: best[kk] for kk in
-                              ("g", "unpack", "mm", "pack", "tile")}
-        with open(_TUNED_PATH, "w") as f:
-            json.dump(tuned, f, indent=2, sort_keys=True)
-        log(f"wrote {_TUNED_PATH}: k={args.k} -> {tuned[str(args.k)]}")
+        path = args.out or _TUNED_PATH
+        update: dict = {}
+        if results:
+            update[str(args.k)] = {kk: results[0][kk] for kk in
+                                   ("g", "unpack", "mm", "pack",
+                                    "tile")}
+        if engines is not None:
+            update["xor_sched"] = {
+                f"{args.k},{args.m},{args.chunk}": engines,
+                f"{args.k},{args.m}": engines,
+            }
+        _write_tuned(path, update)
     return 0
 
 

@@ -94,6 +94,7 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
         # roll up batch occupancy over every OSD's aggregation stage
         batches = stripes = pad = fallback = 0
         mesh_launches = mesh_padded = mesh_fallbacks = 0
+        xor_launches = xor_fallbacks = xor_saved = 0
         n_devices = 0
         flush: dict[str, int] = {}
         for osd in osds:
@@ -105,6 +106,9 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
             mesh_launches += dump.get("mesh_launches", 0)
             mesh_padded += dump.get("mesh_padded_stripes", 0)
             mesh_fallbacks += dump.get("mesh_fallbacks", 0)
+            xor_launches += dump.get("xor_sched_launches", 0)
+            xor_fallbacks += dump.get("xor_sched_fallbacks", 0)
+            xor_saved += dump.get("xor_terms_saved", 0)
             n_devices = max(n_devices,
                             int(dump.get("mesh_devices", 0)))
         for osd in osds:
@@ -132,6 +136,11 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
             "pad_waste_bytes": pad,
             "fallback_ops": fallback,
             "mesh": mesh_report,
+            "xor_sched": {
+                "launches": xor_launches,
+                "fallbacks": xor_fallbacks,
+                "terms_saved": xor_saved,
+            },
             "flush_reasons": flush,
             "n_osds": n_osds, "k": k, "m": m,
             "objects": n_objects, "obj_bytes": obj_bytes,

@@ -161,6 +161,15 @@ def test_osd_path_mesh_smoke_gates_hold():
     assert cluster["fallbacks"] == 0
     assert cluster["launches_per_batch"] == 1.0
     assert cluster["n_devices"] == 8
+    # the XOR-schedule rows: >=30% term reduction on the Cauchy
+    # k=8,m=3 headline matrix, a CPU wall-clock win on the bitmatrix
+    # host row, and zero scheduled fallbacks in the cluster drive
+    xs = res["xor_schedule"]
+    assert xs["reduction_pct"] >= 30.0
+    assert xs["sched_xor_terms"] < xs["naive_xor_terms"]
+    assert xs["bitmatrix_host"]["speedup"] > 1.0
+    assert xs["batched_xla"]["speedup"] > 1.0
+    assert res["xor_sched"]["fallbacks"] == 0
 
 
 def test_datapath_smoke_gates_hold():

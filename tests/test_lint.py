@@ -125,6 +125,44 @@ def test_device_path_injection_two_calls_deep(tmp_path):
     assert "CodecBatcher.encode" in f.message
 
 
+def test_sched_executor_host_sync_flagged(tmp_path):
+    """A host sync hiding inside the XOR-schedule executor is found:
+    the scheduled-kernel entry points are device-path ROOTS, so the
+    closure walks into their helpers like any other launch path."""
+    _write(tmp_path, "xsched.py",
+           "import numpy as np\n"
+           "import jax.numpy as jnp\n\n\n"
+           "def sched_matmul_batch_device(sched, matrix, xd, b, k, l):\n"
+           "    return _run_ops(sched, xd)\n\n\n"
+           "def _run_ops(sched, xd):\n"
+           "    rows = np.asarray(xd)      # the smuggled host hop\n"
+           "    return rows\n")
+    kept, _, _ = lint(["xsched.py"], str(tmp_path),
+                      rules=["device-path-host-sync"])
+    assert len(kept) == 1, [f.render() for f in kept]
+    assert kept[0].path == "xsched.py"
+    assert "sched_matmul_batch_device" in kept[0].message
+
+
+def test_donated_roots_flag_sched_launch_reuse(tmp_path):
+    """The donated-aliasing ROOTS seed the scheduled mesh launch
+    wrappers as donors: a device buffer read after being fed into
+    MeshCodec._sched_launch is a use-after-donate finding, even
+    though the jit carrying donate_argnums never appears in the AST."""
+    _write(tmp_path, "meshy.py",
+           "import jax\n\n\n"
+           "class MeshCodec:\n"
+           "    def _sched_launch(self, fn, dev_batch):\n"
+           "        return fn(dev_batch)\n\n"
+           "    def encode(self, fn, dev):\n"
+           "        out = self._sched_launch(fn, dev)\n"
+           "        return out, dev.sum()   # read-after-donate\n")
+    kept, _, _ = lint(["meshy.py"], str(tmp_path),
+                      rules=["donated-buffer-aliasing"])
+    assert len(kept) == 1, [f.render() for f in kept]
+    assert "dev" in kept[0].message
+
+
 def test_device_path_roots_cover_the_dynamic_gate():
     """Every launch entry point the scalar_calls_on_batched_paths
     bench gate drives resolves to a real function, so the static rule

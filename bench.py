@@ -887,6 +887,83 @@ def _datapath_mode(deadline: float, smoke: bool) -> int:
     return rc
 
 
+def _straggler_mode(deadline: float, smoke: bool) -> int:
+    """--straggler: hedged vs unhedged EC reads under deterministic
+    heavy-tail delays (ceph_tpu/tools/straggler_bench.py).
+
+    One loadgen read phase driven twice -- identical workload,
+    identical per-peer lognormal straggler schedule -- first with
+    ``osd_ec_hedge_enabled=false`` (the fixed-gather baseline), then
+    with the HedgedGather engine live.  Gates (the ISSUE-11 acceptance
+    set): hedged p99 >= 2x better, extra sub-reads <= 1.5x, zero
+    failed/wedged ops, zero leaked sub-read tasks, and every object
+    byte-identical to ground truth in BOTH variants (the unhedged
+    full-set gather is the oracle the first-k decode must match).
+    --smoke keeps it tier-1 sized."""
+    import asyncio
+    from ceph_tpu.tools.straggler_bench import run_straggler_bench
+
+    if smoke:
+        kwargs = dict(n_osds=5, pg_num=32, n_objects=16,
+                      obj_bytes=8 << 10, n_reads=72, n_clients=6)
+    else:
+        kwargs = dict(
+            n_osds=int(os.environ.get("BENCH_STRAG_OSDS", "6")),
+            pg_num=int(os.environ.get("BENCH_STRAG_PGS", "64")),
+            n_objects=int(os.environ.get("BENCH_STRAG_OBJECTS", "48")),
+            obj_bytes=int(os.environ.get("BENCH_STRAG_OBJ_KIB",
+                                         "16")) << 10,
+            n_reads=int(os.environ.get("BENCH_STRAG_READS", "240")),
+            n_clients=int(os.environ.get("BENCH_STRAG_CLIENTS", "8")))
+    log(f"straggler mode: {kwargs} smoke={smoke}")
+    res = asyncio.new_event_loop().run_until_complete(
+        run_straggler_bench(**kwargs, log=log))
+    log(f"straggler: p99 {res['p99_unhedged_s']}s unhedged -> "
+        f"{res['p99_hedged_s']}s hedged ({res['p99_speedup']}x), "
+        f"extra sub-reads {res['extra_subread_ratio']}x, "
+        f"fired={res['hedged']['hedges_fired']} "
+        f"won={res['hedged']['hedges_won']}")
+    RESULT.update({
+        "metric": "straggler_read_p99_speedup_hedged_vs_unhedged",
+        "value": res["p99_speedup"],
+        "unit": "x",
+        "vs_baseline": res["p99_speedup"],
+        "baseline_note": "identical workload + identical seeded "
+                         "heavy-tail delay schedule with "
+                         "osd_ec_hedge_enabled=false (fixed-set "
+                         "gathers await the straggler)",
+        "smoke": smoke,
+        **{key: res[key] for key in
+           ("spec", "p99_unhedged_s", "p99_hedged_s",
+            "extra_subread_ratio", "extra_byte_ratio", "failed_ops",
+            "wedged_ops", "leaked_tasks", "byte_mismatches",
+            "unhedged", "hedged")},
+    })
+    emit()
+    rc = 0
+    if res["byte_mismatches"]:
+        log(f"ERROR: byte mismatches {res['byte_mismatches'][:4]}")
+        rc = 1
+    if res["failed_ops"] or res["wedged_ops"]:
+        log(f"ERROR: {res['failed_ops']} failed / "
+            f"{res['wedged_ops']} wedged ops under stragglers")
+        rc = 1
+    if res["leaked_tasks"]:
+        log(f"ERROR: {res['leaked_tasks']} leaked sub-read tasks")
+        rc = 1
+    if not res["hedged"]["hedges_fired"]:
+        log("ERROR: the hedged drive never fired a hedge")
+        rc = 1
+    if res["p99_speedup"] < 2.0:
+        log(f"ERROR: p99 speedup {res['p99_speedup']}x < 2x floor")
+        rc = 1
+    ratio = res["extra_subread_ratio"]
+    if not ratio or ratio > 1.5:
+        log(f"ERROR: extra sub-read ratio {ratio}x outside (0, 1.5]")
+        rc = 1
+    return rc
+
+
 def _cluster_spec(smoke: bool):
     """The --cluster WorkloadSpec: smoke = small, deterministic,
     tier-1-fast; full = the >=64-OSD / >=10k-object acceptance shape
@@ -1307,6 +1384,9 @@ def main() -> int:
     if "--cluster" in sys.argv[1:] or os.environ.get("BENCH_CLUSTER"):
         _ALLOW_STALE = False
         return _cluster_mode(deadline, "--smoke" in sys.argv[1:])
+    if "--straggler" in sys.argv[1:] or os.environ.get("BENCH_STRAGGLER"):
+        _ALLOW_STALE = False
+        return _straggler_mode(deadline, "--smoke" in sys.argv[1:])
     if "--placement" in sys.argv[1:] or os.environ.get("BENCH_PLACEMENT"):
         _ALLOW_STALE = False
         return _placement_mode(deadline, "--smoke" in sys.argv[1:])

@@ -67,6 +67,13 @@ ROOTS = (
     "scheduled_xor_matmul",
     "MeshCodec._apply_sched",
     "MeshCodec._rmw_sched",
+    # the hedged gather spine (osd/hedged_gather.py): reply buffers
+    # flow straight into decode launches, so a stray host sync in the
+    # engine re-serializes every gather.  (The ECBackend fetch shims
+    # around it are NOT rooted: they call into minimum_to_decode
+    # PLANNING code, whose host-side GF algebra is legitimate.)
+    "HedgedGather.gather_shards",
+    "HedgedGather.first_reply",
 )
 
 # ambiguity budget: a fuzzy call edge that could hit more than this

@@ -12,6 +12,13 @@ keep the handle on ``self``.
 Compliant forms: assign the result (to a name, attribute, or through
 a tracker like ``self._track(...)``), await it, or chain an immediate
 ``.add_done_callback(...)``.
+
+Beyond the raw asyncio spawners, TASK_ROOTS names project APIs that
+RETURN a live task the caller must own -- ``OSD.start_request`` hands
+back ``(tid, task)`` and the HedgedGather engine is the one place
+that cancels AND reaps those sub-reads; a bare ``start_request(...)``
+statement is a sub-read nobody will ever cancel, whose late reply
+nobody will ever drain.
 """
 
 from __future__ import annotations
@@ -24,6 +31,11 @@ from ..core import Finding, Module
 from ..registry import Checker, register
 
 _SPAWNERS = {"create_task", "ensure_future"}
+
+# task-returning project APIs: the result carries a live task the
+# caller owns (HedgedGather entry points ride start_request; dropping
+# the tuple orphans the sub-read task)
+TASK_ROOTS = {"start_request"}
 
 
 @register
@@ -45,3 +57,11 @@ class DroppedTask(Checker):
                     f"never retrieved and the GC may cancel it "
                     f"mid-flight; keep a reference (tracker/attribute) "
                     f"or attach a done-callback")
+            elif leaf in TASK_ROOTS:
+                yield Finding(
+                    module.path, node.lineno, self.name,
+                    f"{leaf}() result dropped: it returns a live "
+                    f"sub-read task the caller owns -- unowned, it is "
+                    f"never cancelled or reaped and its late reply is "
+                    f"never drained (the HedgedGather engine is the "
+                    f"intended owner on the read spine)")

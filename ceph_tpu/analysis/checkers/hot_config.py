@@ -48,6 +48,9 @@ ROOTS = (
     "StripeInfo.reconstruct_logical_async",
     "ECBackend._fetch_shards",
     "ECBackend._gather_shards",
+    "ECBackend.collect_shard_states",
+    "HedgedGather.gather_shards",
+    "HedgedGather.first_reply",
     "DeviceShardCache.get",
     "DeviceShardCache.put",
     "VectorCrush.map_pgs",

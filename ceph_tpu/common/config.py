@@ -106,6 +106,27 @@ DEFAULT_SCHEMA: list[Option] = [
            "sources before erroring the read", min=0),
     Option("osd_ec_read_backoff", OPT_FLOAT, 0.25,
            "base backoff between shard-gather retry rounds", min=0.0),
+    Option("osd_ec_hedge_enabled", OPT_BOOL, True,
+           "straggler-tolerant EC gathers: request extra shards after "
+           "the adaptive per-peer latency quantile and decode from "
+           "the first sufficient set (osd/hedged_gather.py)"),
+    Option("osd_ec_hedge_quantile", OPT_FLOAT, 0.9,
+           "latency quantile of the candidate-peer cohort the hedge "
+           "timer arms on", min=0.5, max=0.999),
+    Option("osd_ec_hedge_delay_min", OPT_FLOAT, 0.002,
+           "hedge delay floor in seconds (never hedge faster than "
+           "this, however fast the cohort looks)", min=0.0),
+    Option("osd_ec_hedge_delay_max", OPT_FLOAT, 1.0,
+           "hedge delay ceiling in seconds; also the conservative "
+           "delay while the peer EWMAs are cold", min=0.001),
+    Option("osd_ec_hedge_max_extra", OPT_INT, 2,
+           "max extra shards (h) one hedge fire may request", min=0),
+    Option("osd_ec_hedge_min_samples", OPT_INT, 8,
+           "sub-read samples before a peer's EWMA quantile estimate "
+           "is trusted by the hedge timer", min=1),
+    Option("osd_ec_hedge_ewma_alpha", OPT_FLOAT, 0.2,
+           "EWMA smoothing factor for per-peer sub-read latency",
+           min=0.001, max=1.0),
     Option("osd_max_backfills", OPT_INT, 2,
            "concurrent backfill reservations per OSD (local+remote)",
            min=1),

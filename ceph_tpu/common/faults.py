@@ -13,10 +13,18 @@ same drop/delay schedule from the same seed (fault_injector.h keeps
 its injection deterministic for the same reason).  tools/chaos.py
 drives clusters with one of these per daemon; tests pin the
 schedule-reproducibility in tests/test_fault_injection.py.
+
+Straggler mode: ``straggler()`` arms per-peer HEAVY-TAIL delay
+profiles (seeded lognormal / pareto draws from a per-(seed, peer) RNG
+stream, so each peer's delay sequence replays independently of
+cross-peer message ordering) -- the induced-straggler workload the
+hedged-read engine (osd/hedged_gather.py) and ``bench.py
+--straggler`` measure against.
 """
 
 from __future__ import annotations
 
+import math
 import random
 from dataclasses import dataclass, field
 
@@ -24,6 +32,10 @@ from dataclasses import dataclass, field
 SEND = "send"
 RECV = "recv"
 BOTH = "both"
+
+# heavy-tail delay distributions a rule may draw from ("fixed" = the
+# classic constant `delay`)
+DISTRIBUTIONS = ("fixed", "lognormal", "pareto")
 
 
 def _match_name(pattern: str | None, name: str) -> bool:
@@ -38,7 +50,17 @@ def _match_name(pattern: str | None, name: str) -> bool:
 
 @dataclass
 class FaultRule:
-    """One armed fault: `action` on messages matching peer/mtype."""
+    """One armed fault: `action` on messages matching peer/mtype.
+
+    Delay rules may carry a heavy-tail DISTRIBUTION instead of the
+    fixed ``delay``: ``dist="lognormal"`` (params ``mu``/``sigma`` of
+    the underlying normal) or ``dist="pareto"`` (params ``scale``/
+    ``alpha``; alpha <= 1 has infinite mean -- the true straggler
+    regime).  ``cap`` bounds any sample so a test's worst case stays
+    finite.  Distribution rules draw from a PER-PEER seeded RNG stream
+    (see MessageFaultInjector), so each peer's delay sequence is a
+    deterministic function of (seed, peer) alone.
+    """
 
     action: str                      # "drop" | "delay" | "dup"
     peer: str | None = None          # peer name or "svc." prefix
@@ -46,8 +68,14 @@ class FaultRule:
     direction: str = BOTH            # send / recv / both
     probability: float = 1.0
     count: int | None = None         # remaining firings; None = forever
-    delay: float = 0.05              # seconds, for "delay"
+    delay: float = 0.05              # seconds, for "delay" dist=fixed
+    dist: str = "fixed"              # fixed | lognormal | pareto
+    dist_params: dict = field(default_factory=dict)
     fired: int = 0
+
+    def __post_init__(self) -> None:
+        if self.dist not in DISTRIBUTIONS:
+            raise ValueError(f"unknown delay distribution {self.dist!r}")
 
     def matches(self, direction: str, peer: str, mtype: str) -> bool:
         if self.count is not None and self.count <= 0:
@@ -56,6 +84,21 @@ class FaultRule:
             return False
         return _match_name(self.peer, peer) and (
             self.mtype is None or self.mtype == mtype)
+
+    def sample_delay(self, rng: random.Random) -> float:
+        """One delay draw (seconds) from this rule's distribution."""
+        p = self.dist_params
+        if self.dist == "lognormal":
+            v = rng.lognormvariate(
+                p.get("mu", math.log(max(self.delay, 1e-9))),
+                p.get("sigma", 1.0))
+        elif self.dist == "pareto":
+            v = p.get("scale", self.delay) * rng.paretovariate(
+                p.get("alpha", 1.5))
+        else:
+            return self.delay
+        cap = p.get("cap")
+        return min(v, cap) if cap is not None else v
 
 
 @dataclass
@@ -82,6 +125,11 @@ class MessageFaultInjector:
         self.partitions: list[tuple[str, str]] = []
         self.stats: dict[str, int] = {}
         self.perf = perf             # optional PerfCounters sink
+        # per-peer RNG streams for distribution-backed delay rules:
+        # each peer's delay sequence is seeded by (seed, peer) ALONE,
+        # so reordering traffic across peers -- or adding an unrelated
+        # straggler profile -- cannot shift another peer's schedule
+        self._peer_rngs: dict[str, random.Random] = {}
 
     # -- arming --------------------------------------------------------------
     def add_rule(self, rule: FaultRule) -> FaultRule:
@@ -101,6 +149,25 @@ class MessageFaultInjector:
         return self.add_rule(FaultRule("delay", peer, mtype, direction,
                                        probability, count,
                                        delay=seconds))
+
+    def straggler(self, peer: str, *, dist: str = "lognormal",
+                  mtype: str | None = None, direction: str = RECV,
+                  probability: float = 1.0, count: int | None = None,
+                  **params) -> FaultRule:
+        """Arm a heavy-tail per-peer straggler profile.
+
+        ``dist="lognormal"`` takes mu/sigma (seconds of the underlying
+        normal's exp); ``dist="pareto"`` takes scale/alpha; both honor
+        ``cap``.  Defaults to RECV so the delay lands in the receiver's
+        dispatch task (a SEND delay would serialize the whole
+        connection behind the sleep and stall unrelated traffic --
+        stragglers are slow, not head-of-line-blocking).  Same seed ->
+        same per-peer delay sequence: the draw comes from the peer's
+        own RNG stream, so a chaos run's straggler schedule replays
+        exactly."""
+        return self.add_rule(FaultRule(
+            "delay", peer, mtype, direction, probability, count,
+            dist=dist, dist_params=dict(params)))
 
     def duplicate(self, *, peer: str | None = None,
                   mtype: str | None = None, direction: str = BOTH,
@@ -139,6 +206,13 @@ class MessageFaultInjector:
                 return True
         return False
 
+    def _peer_rng(self, peer: str) -> random.Random:
+        rng = self._peer_rngs.get(peer)
+        if rng is None:
+            rng = self._peer_rngs[peer] = random.Random(
+                f"{self.seed}:straggler:{peer}")
+        return rng
+
     def decide(self, direction: str, local: str, peer: str,
                mtype: str) -> FaultDecision:
         """One deterministic decision for one message traversal."""
@@ -151,9 +225,14 @@ class MessageFaultInjector:
                 continue
             # the RNG is consumed ONLY for matching rules with p < 1 so
             # unrelated traffic cannot shift the schedule of the flow
-            # under test
+            # under test; distribution-backed rules draw EVERYTHING
+            # (probability and delay) from the peer's own stream so the
+            # per-peer sequence is independent of cross-peer ordering
+            dist_rule = (rule.action == "delay"
+                         and rule.dist != "fixed")
+            draw = self._peer_rng(peer) if dist_rule else self._rng
             if rule.probability < 1.0 and \
-                    self._rng.random() >= rule.probability:
+                    draw.random() >= rule.probability:
                 continue
             rule.fired += 1
             if rule.count is not None:
@@ -164,7 +243,12 @@ class MessageFaultInjector:
                 return out
             if rule.action == "delay":
                 self._count("delayed")
-                out.delay += rule.delay
+                if dist_rule:
+                    self._count("straggler_delays")
+                    out.delay += rule.sample_delay(
+                        self._peer_rng(peer))
+                else:
+                    out.delay += rule.delay
             elif rule.action == "dup":
                 self._count("duplicated")
                 out.copies += 1

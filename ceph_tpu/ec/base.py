@@ -101,7 +101,35 @@ class ErasureCode(ErasureCodeInterface):
     def minimum_to_decode_with_cost(
         self, want_to_read: set[int], available: Mapping[int, int],
     ) -> set[int]:
-        return self._minimum_to_decode(want_to_read, set(available))
+        """Cheapest feasible read set under per-chunk retrieval costs.
+
+        Costs are grown tier by tier (cheapest first) and the FIRST
+        feasible candidate set wins; ``_minimum_to_decode`` picks the
+        actual reads WITHIN that set, so a subclass's selection policy
+        (the LRC plugin's locality preference, SHEC's decoding-system
+        search) composes with the cost ordering instead of being
+        overridden by it.  The hedged read path feeds per-peer latency
+        EWMAs in as costs: in-hand shards cost zero, straggling
+        outstanding sub-reads carry a lateness penalty, so the plan it
+        gets back routes around the slow source.  With uniform costs
+        this degrades to the old behavior exactly.
+        """
+        want = set(want_to_read)
+        order = sorted(available, key=lambda s: (available[s], s))
+        cand: set[int] = set()
+        i = 0
+        while i < len(order):
+            cost = available[order[i]]
+            while i < len(order) and available[order[i]] == cost:
+                cand.add(order[i])
+                i += 1
+            if i < len(order):      # more tiers left: probe this one
+                try:
+                    return self._minimum_to_decode(want, set(cand))
+                except (IOError, OSError, ValueError):
+                    continue
+        # last tier = everything available; let its error propagate
+        return self._minimum_to_decode(want, set(available))
 
     # -- encode/decode drivers ---------------------------------------------
     def get_chunk_size(self, stripe_width: int) -> int:

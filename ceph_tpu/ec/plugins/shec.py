@@ -223,9 +223,10 @@ class ErasureCodeShec(ErasureCode):
                                           set(available))
         return {shard: [(0, 1)] for shard in sorted(minimum)}
 
-    def minimum_to_decode_with_cost(self, want_to_read, available):
-        return self._minimum_to_decode(set(want_to_read),
-                                       set(available))
+    # minimum_to_decode_with_cost: inherited from ErasureCode -- the
+    # cost-tier growth there calls back into this plugin's
+    # _minimum_to_decode, so the decoding-system search still picks
+    # the reads within the cheapest feasible candidate set.
 
     # -- data path -----------------------------------------------------------
     def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:

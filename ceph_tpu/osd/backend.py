@@ -394,6 +394,11 @@ class ECBackend(PGBackend):
         # (MeshCodec.rmw / CodecBatcher.rmw) instead of re-encoding
         # whole stripes; snapshot, never read per write
         self._rmw_delta = self._cfg("osd_ec_rmw_delta_enabled", True)
+        # straggler-tolerant gathers: the OSD-wide HedgedGather engine
+        # (osd/hedged_gather.py) + per-peer latency EWMA.  None on bare
+        # test backends -- every hedged path degrades to the legacy
+        # fixed fanout.
+        self.hedger = getattr(self.osd, "hedger", None)
 
     def _count(self, key: str, by: int = 1) -> None:
         if self.perf_degraded is not None:
@@ -504,64 +509,30 @@ class ECBackend(PGBackend):
             return True
         return label is None or int(label) == shard
 
-    async def _fetch_shards(self, oid: str, shards: list[int],
-                            avail: dict[int, int],
-                            rng: tuple[int, int] | None = None,
-                            timeout: float = 10.0
-                            ) -> tuple[dict, set[int], dict]:
-        """Fetch several shards' (buf, size, ver) with ONE parallel
-        fanout (the hot read path: serial round trips would multiply
-        latency by k).
+    def _entry_from_reply(self, rep, default_shard: int | None = None
+                          ) -> tuple:
+        """An ec_subop_read reply as a gather entry: (shard, label,
+        crc, buf, size, ver, trusted)."""
+        s = rep.data.get("req_shard", rep.data.get("shard",
+                                                   default_shard))
+        buf = np.frombuffer(
+            rep.segments[0] if rep.segments else b"", np.uint8)
+        return (s, rep.data.get("shard"), rep.data.get("crc"), buf,
+                rep.data.get("size", 0),
+                tuple(rep.data.get("ver", (0, 0))), False)
 
-        Returns (fetched, failed, relabeled): a shard lands in
-        ``failed`` when its source did not answer inside ``timeout``,
-        reported a mismatched write-time shard label, or returned bytes
-        that fail the CRC tag -- the caller excludes those sources and
-        re-plans, so a dead or mislabeled source can never wedge or
-        corrupt a read.  A mismatched source whose bytes verify under
-        their OWN label goes into ``relabeled`` keyed by that label: a
-        remapped OSD's old-shard bytes are still perfectly good data
-        for the shard they WERE, and using them is what lets reads and
-        recovery converge while relocation is in flight."""
-        out: dict[int, tuple] = {}
-        failed: set[int] = set()
-        relabeled: dict[int, tuple] = {}
-        # (shard, label, crc, buf, size, ver, trusted); trusted marks
-        # cache-resident content verified at fill/write time
-        entries: list[tuple] = []
+    def _admit_entries(self, entries: list[tuple],
+                       rng: tuple[int, int] | None,
+                       out: dict, failed: set,
+                       relabeled: dict) -> set[int]:
+        """Verify one batch of gathered entries into the caller's
+        (out, failed, relabeled) state; returns the accepted shards.
 
-        remote = []
-        for s in shards:
-            if avail[s] == self.osd.whoami:
-                buf, size, ver, label, crc, cached = \
-                    self._local_entry(oid, rng)
-                entries.append((s, label, crc, buf, size, ver, cached))
-            else:
-                remote.append(s)
-        if remote:
-            payload = {"pgid": self.pg.pgid, "oid": oid}
-            if rng is not None:
-                payload["off"], payload["len"] = rng
-            replies = await self.osd.fanout_and_wait(
-                [(avail[s], "ec_subop_read",
-                  {**payload, "shard": s}, [])
-                 for s in remote],
-                collect=True, timeout=timeout)
-            for rep in replies:
-                s = rep.data.get("req_shard", rep.data.get("shard"))
-                if s is None or s not in remote:
-                    continue
-                buf = np.frombuffer(
-                    rep.segments[0] if rep.segments else b"", np.uint8)
-                entries.append(
-                    (s, rep.data.get("shard"), rep.data.get("crc"),
-                     buf, rep.data.get("size", 0),
-                     tuple(rep.data.get("ver", (0, 0))), False))
-        # whole-shard fetches verify their CRC tags in ONE batched pass
-        # over every gathered buffer (the hot read path used to re-hash
-        # each reply with its own scalar host call); cache-resident
-        # buffers were verified when they became resident and skip the
-        # re-hash entirely -- deep scrub re-checks them on its cadence
+        Whole-shard fetches verify their CRC tags in ONE batched pass
+        over the batch (the hot read path used to re-hash each reply
+        with its own scalar host call); cache-resident buffers were
+        verified when they became resident and skip the re-hash
+        entirely -- deep scrub re-checks them on its cadence."""
         have: dict[int, int] = {}
         if rng is None:
             idx = [i for i, e in enumerate(entries) if not e[6]]
@@ -569,7 +540,7 @@ class ECBackend(PGBackend):
                 from ..ops.crc32c_batch import crc32c_batch
                 crcs = crc32c_batch([entries[i][3] for i in idx])
                 have = {i: int(c) for i, c in zip(idx, crcs)}
-
+        accepted: set[int] = set()
         for i, (s, label, crc, buf, size, ver,
                 trusted) in enumerate(entries):
             hv = have.get(i)
@@ -592,9 +563,192 @@ class ECBackend(PGBackend):
                 failed.add(s)
                 continue
             out[s] = (buf, size, ver)
+            accepted.add(s)
+        return accepted
+
+    async def _fetch_shards(self, oid: str, shards: list[int],
+                            avail: dict[int, int],
+                            rng: tuple[int, int] | None = None,
+                            timeout: float = 10.0, *,
+                            want: set[int] | None = None,
+                            have: frozenset = frozenset(),
+                            rejected: frozenset = frozenset()
+                            ) -> tuple[dict, set[int], dict]:
+        """Fetch several shards' (buf, size, ver) in ONE parallel pass
+        (the hot read path: serial round trips would multiply latency
+        by k).
+
+        With ``want`` given (and the OSD's HedgedGather enabled), the
+        remote sub-reads are HEDGED: issued individually, a hedge
+        timer armed off the per-peer latency EWMA's adaptive quantile,
+        extra shards requested on fire, and the gather completed on
+        the FIRST verified sufficient set -- a straggling source is
+        decoded around instead of awaited.  Without ``want`` (ranged
+        RMW parity fetches, bare-test backends) the legacy fixed
+        fanout runs.
+
+        Returns (fetched, failed, relabeled): a shard lands in
+        ``failed`` when its source did not answer inside ``timeout``
+        (and the gather still needed it), reported a mismatched
+        write-time shard label, or returned bytes that fail the CRC
+        tag -- the caller excludes those sources and re-plans, so a
+        dead or mislabeled source can never wedge or corrupt a read.
+        A sub-read cancelled because the gather already held a
+        sufficient set is NOT failed: its source is merely slow.  A
+        mismatched source whose bytes verify under their OWN label
+        goes into ``relabeled`` keyed by that label: a remapped OSD's
+        old-shard bytes are still perfectly good data for the shard
+        they WERE, and using them is what lets reads and recovery
+        converge while relocation is in flight."""
+        out: dict[int, tuple] = {}
+        failed: set[int] = set()
+        relabeled: dict[int, tuple] = {}
+        # (shard, label, crc, buf, size, ver, trusted); trusted marks
+        # cache-resident content verified at fill/write time
+        entries: list[tuple] = []
+        remote = []
+        for s in shards:
+            if avail[s] == self.osd.whoami:
+                buf, size, ver, label, crc, cached = \
+                    self._local_entry(oid, rng)
+                entries.append((s, label, crc, buf, size, ver, cached))
+            else:
+                remote.append(s)
+        self._admit_entries(entries, rng, out, failed, relabeled)
+        if not remote:
+            return out, failed, relabeled
+        hedger = self.hedger
+        if want is not None and hedger is not None and hedger.enabled:
+            await self._fetch_remote_hedged(
+                oid, remote, avail, rng, timeout, set(want),
+                set(have), set(rejected), out, failed, relabeled)
+        else:
+            await self._fetch_remote_fanout(
+                oid, remote, avail, rng, timeout, out, failed,
+                relabeled)
+        return out, failed, relabeled
+
+    async def _fetch_remote_fanout(self, oid, remote, avail, rng,
+                                   timeout, out, failed,
+                                   relabeled) -> None:
+        """Legacy fixed fan-out: one parallel wait for every reply."""
+        payload = {"pgid": self.pg.pgid, "oid": oid}
+        if rng is not None:
+            payload["off"], payload["len"] = rng
+        replies = await self.osd.fanout_and_wait(
+            [(avail[s], "ec_subop_read", {**payload, "shard": s}, [])
+             for s in remote],
+            collect=True, timeout=timeout)
+        # same sub-read accounting as the hedged path, so a hedged-vs-
+        # unhedged comparison (bench.py --straggler's extra-bytes gate)
+        # reads one counter set either way
+        if self.hedger is not None:
+            self.hedger.note("subreads", len(remote))
+            self.hedger.note("subread_bytes",
+                             sum(len(seg) for rep in replies
+                                 for seg in rep.segments))
+        entries = []
+        for rep in replies:
+            e = self._entry_from_reply(rep)
+            if e[0] is None or e[0] not in remote:
+                continue
+            entries.append(e)
+        self._admit_entries(entries, rng, out, failed, relabeled)
         failed |= {s for s in remote
                    if s not in out and s not in failed}
-        return out, failed, relabeled
+
+    async def _fetch_remote_hedged(self, oid, remote, avail, rng,
+                                   timeout, want, have, rejected,
+                                   out, failed, relabeled) -> None:
+        """First-k-of-(k+h) remote gather through the OSD's
+        HedgedGather engine.
+
+        Sufficiency re-plans ``minimum_to_decode`` over everything
+        verified so far (prior rounds + this one + relabeled salvage),
+        so a late-set switch -- the hedged parity shard arriving
+        before a straggling data shard -- completes the gather with a
+        DIFFERENT set than originally planned; the decode-repair-
+        matrix cache makes that switch cheap downstream.  Hedge extras
+        are chosen by ``minimum_to_decode_with_cost`` with per-peer
+        EWMA costs (in-hand shards cost zero, outstanding stragglers
+        carry a lateness penalty), which preserves the LRC plugin's
+        locality preference."""
+        hedger = self.hedger
+        tracker = hedger.tracker
+        payload = {"pgid": self.pg.pgid, "oid": oid}
+        if rng is not None:
+            payload["off"], payload["len"] = rng
+
+        def sub(s):
+            return (avail[s], "ec_subop_read", {**payload, "shard": s})
+
+        plan = {s: sub(s) for s in remote}
+        pool = {s: sub(s) for s in avail
+                if s not in remote and s not in have
+                and s not in rejected
+                and avail[s] != self.osd.whoami}
+        pending_entries: list[tuple] = []
+
+        def on_reply(s, msg):
+            if msg is None:                  # send failure: dead peer
+                failed.add(s)
+                return
+            pending_entries.append(
+                self._entry_from_reply(msg, default_shard=s))
+
+        def flush():
+            if pending_entries:
+                self._admit_entries(pending_entries, rng, out, failed,
+                                    relabeled)
+                pending_entries.clear()
+
+        def sufficient():
+            flush()
+            usable = have | set(out) | set(relabeled)
+            try:
+                plan2 = set(self.codec.minimum_to_decode(want, usable))
+            except Exception:
+                return False
+            return plan2 if plan2 <= usable else False
+
+        default_s = hedger.delay_max
+        late_penalty = int(1e6 * hedger.delay_max) + 1
+
+        def choose_extras(h):
+            flush()
+            in_hand = have | set(out) | set(relabeled)
+            costs = {s: 0 for s in in_hand}
+            for s in plan:
+                if s not in costs and s not in failed:
+                    # outstanding and already late relative to the
+                    # cohort quantile: costlier than any fresh source
+                    costs[s] = tracker.cost_us(avail[s], default_s) \
+                        + late_penalty
+            for s in pool:
+                if s not in costs:
+                    costs[s] = max(
+                        1, tracker.cost_us(avail[s], default_s))
+            try:
+                cheap = set(self.codec.minimum_to_decode_with_cost(
+                    set(want), costs))
+            except Exception:
+                return {}
+            picks = sorted(s for s in cheap if s in pool)[:h]
+            return {s: pool[s] for s in picks}
+
+        outcome = await hedger.gather_shards(
+            plan, on_reply=on_reply, sufficient=sufficient,
+            hedge_pool=pool, choose_extras=choose_extras,
+            timeout=timeout)
+        flush()
+        if not outcome.completed:
+            # sources that never answered (and were still needed) are
+            # failures for the caller's re-plan; cancelled sub-reads
+            # of a COMPLETED gather never land here
+            failed |= {s for s in outcome.timed_out if s not in out}
+            failed |= {s for s in remote
+                       if s not in out and s not in failed
+                       and s not in outcome.cancelled}
 
     async def _gather_shards(self, oid: str,
                              need_shards: set[int] | None = None,
@@ -641,25 +795,41 @@ class ECBackend(PGBackend):
             to_fetch = sorted(s for s in plan - set(fetched)
                               if s in avail)
             got, failed, relabeled = await self._fetch_shards(
-                oid, to_fetch, avail, rng, timeout)
+                oid, to_fetch, avail, rng, timeout, want=want,
+                have=frozenset(fetched), rejected=frozenset(rejected))
             fetched.update(got)
             for label, item in relabeled.items():
                 # direct position-keyed fetches take precedence over
                 # salvage; salvage never overwrites either
                 fetched.setdefault(label, item)
-            if failed:
-                rejected |= failed
+            rejected |= failed
+            # decodable from what's in hand?  A hedged fetch may have
+            # completed with a DIFFERENT sufficient set than the
+            # pre-fetch plan (the late-set switch), so re-plan over the
+            # fetched set instead of insisting on the original one.
+            try:
+                plan2 = set(self.codec.minimum_to_decode(
+                    want, set(fetched)))
+            except Exception:
+                plan2 = None
+            if plan2 is None or not plan2 <= set(fetched):
+                # insufficient: THIS is the only path into the retry/
+                # backoff ladder.  A gather already holding a
+                # sufficient set can therefore never ALSO schedule a
+                # retry round -- hedging does not multiply with
+                # osd_ec_read_retries (the combined sub-read bound is
+                # pinned in tests/test_hedged_reads.py).
                 self._count("gather_retries")
                 if backoff > 0 and attempt < retries:
                     await asyncio.sleep(min(backoff * (2 ** attempt),
                                             2.0))
                 continue                     # re-plan around the losses
-            vers = {s: fetched[s][2] for s in plan}
+            vers = {s: fetched[s][2] for s in plan2}
             newest = max(vers.values())
             stale = {s for s, v in vers.items() if v < newest}
             if not stale:
-                bufs = {s: fetched[s][0] for s in plan}
-                size = max((fetched[s][1] for s in plan), default=0)
+                bufs = {s: fetched[s][0] for s in plan2}
+                size = max((fetched[s][1] for s in plan2), default=0)
                 # ranged reads must pad every shard to the full range so
                 # decode sees aligned slices (a short read = the shard
                 # file ends inside the range; logical zeros beyond)
@@ -689,6 +859,65 @@ class ECBackend(PGBackend):
         data = await self.sinfo.reconstruct_logical_async(
             self.codec, bufs, batcher=self.batcher)
         return data[:size]
+
+    async def collect_shard_states(self, oid: str
+                                   ) -> tuple[list[tuple], int]:
+        """Every up acting shard's stored state for scrub: a list of
+        (shard, buf, label, crc, ver, trusted) plus the count of up
+        acting shards.
+
+        One PARALLEL gather through the HedgedGather sub-read
+        machinery (scrub used to round-trip each shard serially, so a
+        deep scrub of a wide stripe paid k+m sequential RTTs); every
+        reply feeds the same per-peer latency EWMA the hedge timer
+        draws from.  No hedging applies -- scrub wants EVERY stored
+        shard, not a sufficient subset -- but a straggler is bounded
+        by the read deadline instead of stalling the whole scrub: a
+        missing shard simply falls out to the reconstruct path."""
+        pg = self.pg
+        stored: list[tuple] = []
+        remote: dict[int, int] = {}
+        n_acting = 0
+        for shard, osd_id in enumerate(pg.acting):
+            if osd_id < 0 or not self.osd.osd_is_up(osd_id):
+                continue
+            n_acting += 1
+            if osd_id == self.osd.whoami:
+                buf, _, over, label, crc, cached = \
+                    self._local_entry(oid)
+                stored.append((shard, buf, label, crc, tuple(over),
+                               cached))
+            else:
+                remote[shard] = osd_id
+        if remote:
+            payload = {"pgid": pg.pgid, "oid": oid}
+            collected: dict[int, object] = {}
+            if self.hedger is not None:
+                def on_reply(s, msg):
+                    if msg is not None:
+                        collected[s] = msg
+                await self.hedger.gather_shards(
+                    {s: (o, "ec_subop_read",
+                         {**payload, "shard": s})
+                     for s, o in remote.items()},
+                    on_reply=on_reply, timeout=self._read_timeout)
+            else:
+                replies = await self.osd.fanout_and_wait(
+                    [(o, "ec_subop_read", {**payload, "shard": s}, [])
+                     for s, o in remote.items()],
+                    collect=True, timeout=self._read_timeout)
+                for rep in replies:
+                    s = rep.data.get("req_shard", rep.data.get("shard"))
+                    if s in remote:
+                        collected[s] = rep
+            for s, rep in sorted(collected.items()):
+                raw = rep.segments[0] if rep.segments else b""
+                stored.append((s, raw, rep.data.get("shard"),
+                               rep.data.get("crc"),
+                               tuple(rep.data.get("ver", (0, 0))),
+                               False))
+        stored.sort(key=lambda e: e[0])
+        return stored, n_acting
 
     # -- write path ---------------------------------------------------------
     async def submit_transaction(self, entry, muts) -> None:

@@ -163,6 +163,17 @@ class OSD:
         self.shard_cache = DeviceShardCache.from_config(self.config)
         self.store.attach_shard_cache(self.shard_cache)
         self.perf.adopt(_datapath_perf)
+        # straggler-tolerant hedged gathers (osd/hedged_gather.py):
+        # ONE engine + per-peer latency EWMA per daemon -- every
+        # ECBackend, scrub collection and recovery pull on this OSD
+        # shares the tracker (a peer's history is a daemon-level fact)
+        # and the "ec_hedge" perf set.  All osd_ec_hedge_* knobs are
+        # snapshot here, once.
+        from .hedged_gather import HedgedGather, PeerLatencyEWMA
+        self.peer_latency = PeerLatencyEWMA.from_config(self.config)
+        self.hedger = HedgedGather.from_config(
+            self, self.config, perf=self.perf.create("ec_hedge"),
+            tracker=self.peer_latency)
         self._notify_serial = itertools.count(1)
         self._notify_waiters: dict[str, asyncio.Future] = {}
         # TrackedOp/OpTracker (src/common/TrackedOp.h): in-flight op
@@ -613,6 +624,46 @@ class OSD:
         if info is None or info.addr is None:
             raise ConnectionError(f"no address for osd.{osd}")
         return tuple(info.addr)
+
+    def start_request(self, osd: int, mtype: str, data: dict,
+                      segments=()) -> tuple[int, asyncio.Task]:
+        """Issue ONE peer request; the returned task resolves to the
+        reply Message (matched by tid, like fanout_and_wait) or raises
+        ConnectionError on a send failure.
+
+        The caller OWNS the task: awaiting, cancelling and reaping it
+        are its job (HedgedGather is the owning engine on the read
+        spine).  Cancellation pops the tid waiter in the task's
+        finally, so a straggler's late reply is dropped at the
+        dispatch layer instead of crosstalking into a later op that
+        happens to reuse the wire."""
+        tid = next(self._tid)
+        fut = asyncio.get_event_loop().create_future()
+        self._waiters[tid] = fut
+        d = dict(data)
+        d["tid"] = tid
+
+        async def _issue():
+            try:
+                try:
+                    await self.msgr.send(
+                        self._peer_addr(osd), f"osd.{osd}",
+                        Message(mtype, d, segments=list(segments)))
+                except (ConnectionError, OSError) as e:
+                    if not fut.done():
+                        fut.set_exception(ConnectionError(str(e)))
+                return await fut
+            finally:
+                self._waiters.pop(tid, None)
+                # a cancel landing between the send failure and the
+                # await leaves the failure un-consumed: mark it
+                # retrieved (or park the waiter) so nothing warns at GC
+                if fut.done() and not fut.cancelled():
+                    fut.exception()
+                else:
+                    fut.cancel()
+
+        return tid, asyncio.ensure_future(_issue())
 
     async def fanout_and_wait(self, requests, collect: bool = False,
                               timeout: float = 10):

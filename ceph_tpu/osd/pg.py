@@ -1546,14 +1546,26 @@ class PG:
                        o, MissingSet()).is_missing(oid)]
         if not sources:
             return        # unfound; retried on next peering round
-        replies = await self.osd.fanout_and_wait(
-            [(sources[0], "pg_pull",
-              {"pgid": self.pgid, "oid": oid,
-               "shard": self._shard_of(self.whoami)}, [])],
-            collect=True, timeout=10)
-        if not replies or replies[0].data.get("err"):
-            return                      # source not ready; retried later
-        rep = replies[0]
+        payload = {"pgid": self.pgid, "oid": oid,
+                   "shard": self._shard_of(self.whoami)}
+        hedger = getattr(self.osd, "hedger", None)
+        if hedger is not None and hedger.enabled and len(sources) > 1:
+            # hedged pull: every listed source can serve this object,
+            # so a straggling (or EIO-answering) source escalates to
+            # the next one after the cohort's adaptive quantile
+            # instead of eating the full timeout before the retry
+            rep = await hedger.first_reply(
+                sources, "pg_pull", payload, timeout=10,
+                accept=lambda m: not m.data.get("err"))
+            if rep is None:
+                return              # no source ready; retried later
+        else:
+            replies = await self.osd.fanout_and_wait(
+                [(sources[0], "pg_pull", payload, [])],
+                collect=True, timeout=10)
+            if not replies or replies[0].data.get("err"):
+                return              # source not ready; retried later
+            rep = replies[0]
         try:
             self._apply_recovery_payload(oid, rep.data, rep.segments)
         except ValueError:

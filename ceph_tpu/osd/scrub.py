@@ -212,34 +212,13 @@ async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
     for oid in oids:
         # fetch every stored shard + its write-time identity tags
         # (shard label / crc / version) -- scrub is where silent tag
-        # rot gets caught.  Local shards ride the device cache.
-        stored: list[tuple] = []     # (shard, raw, label, crc, ver, res)
-        n_acting = 0
-        for shard, osd_id in enumerate(pg.acting):
-            if osd_id < 0 or not pg.osd.osd_is_up(osd_id):
-                continue
-            n_acting += 1
-            if osd_id == pg.whoami:
-                buf, _, over, label, crc, cached = \
-                    backend._local_entry(oid)
-                stored.append((shard, buf, label, crc, tuple(over),
-                               cached))
-            else:
-                replies = await pg.osd.fanout_and_wait(
-                    [(osd_id, "ec_subop_read",
-                      {"pgid": pg.pgid, "oid": oid, "shard": shard},
-                      [])],
-                    collect=True, timeout=10)
-                if not replies:
-                    continue
-                raw = (replies[0].segments[0]
-                       if replies[0].segments else b"")
-                stored.append((shard, raw,
-                               replies[0].data.get("shard"),
-                               replies[0].data.get("crc"),
-                               tuple(replies[0].data.get("ver",
-                                                         (0, 0))),
-                               False))
+        # rot gets caught.  Local shards ride the device cache; remote
+        # shards arrive in ONE parallel gather through the hedged
+        # sub-read machinery (the old loop paid one serial round trip
+        # per shard), with every reply feeding the per-peer latency
+        # EWMA.  A shard whose source outlives the read deadline just
+        # falls out to the reconstruct path below.
+        stored, n_acting = await backend.collect_shard_states(oid)
         if not stored:
             continue
         # resident buffers verify via the device kernel; the rest in

@@ -10,3 +10,9 @@ async def serve():
 async def boot(loop):
     asyncio.ensure_future(serve())       # exception never retrieved
     loop.create_task(serve())            # GC may cancel it mid-flight
+
+
+async def hedge(osd):
+    # the (tid, task) tuple is dropped: the sub-read task is orphaned,
+    # never cancelled/reaped, its late reply never drained
+    osd.start_request(3, "ec_subop_read", {"oid": "o", "shard": 1})

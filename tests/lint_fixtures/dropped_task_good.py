@@ -27,3 +27,13 @@ class Daemon:
         asyncio.ensure_future(serve()).add_done_callback(on_death)
         t = loop.create_task(serve())
         t.add_done_callback(on_death)
+
+    async def hedge(self, osd):
+        # the returned sub-read task is owned: awaited then (on the
+        # engine path) cancelled AND reaped by its finally
+        tid, task = osd.start_request(3, "ec_subop_read",
+                                      {"oid": "o", "shard": 1})
+        try:
+            return await task
+        finally:
+            task.cancel()

@@ -233,6 +233,42 @@ def test_cluster_smoke_exits_zero_with_no_failed_ops():
     assert res["p99_degradation"]["degraded"]
 
 
+def test_straggler_smoke_gates_hold():
+    """bench.py --straggler --smoke is the tier-1 tripwire for the
+    hedged-read engine: under an identical seeded heavy-tail delay
+    schedule the hedged variant's p99 must beat the unhedged fixed
+    gather by >= 2x with <= 1.5x extra sub-reads, zero failed/wedged
+    ops, zero leaked sub-read tasks, hedges actually fired AND won,
+    and first-k decode byte-identical to the written ground truth in
+    both variants (the unhedged full-set gather is the oracle)."""
+    import json
+    import os
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--straggler", "--smoke"],
+        capture_output=True, text=True, cwd="/root/repo", env=env,
+        timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    res = json.loads(r.stdout.strip().splitlines()[-1])
+    assert res["metric"] == \
+        "straggler_read_p99_speedup_hedged_vs_unhedged"
+    assert res["value"] >= 2.0
+    assert 0 < res["extra_subread_ratio"] <= 1.5
+    assert res["failed_ops"] == 0 and res["wedged_ops"] == 0
+    assert res["leaked_tasks"] == 0
+    assert res["byte_mismatches"] == []
+    assert res["hedged"]["hedges_fired"] > 0
+    assert res["hedged"]["hedges_won"] > 0
+    # the straggler schedule is deterministic and identical per
+    # variant: both drives saw the same number of injected delays
+    assert res["hedged"]["straggler_delays"] == \
+        res["unhedged"]["straggler_delays"]
+    # hedging never engaged the retry ladder
+    assert res["hedged"]["gather_retries"] == 0
+
+
 def test_placement_smoke_exits_zero_with_fused_parity():
     """bench.py --placement --smoke is the tier-1 tripwire for
     fused/scalar placement divergence: it forces the fused path on a

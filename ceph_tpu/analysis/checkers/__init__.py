@@ -1,6 +1,7 @@
 """Built-in project rules; importing this package registers them."""
 
 from . import (        # noqa: F401
+    await_under_lock,
     blocking_under_lock,
     config_schema,
     counter_coverage,

@@ -74,6 +74,12 @@ ROOTS = (
     # PLANNING code, whose host-side GF algebra is legitimate.)
     "HedgedGather.gather_shards",
     "HedgedGather.first_reply",
+    # the pipelined launch spine (PR 12): the staged launch driver
+    # owns the dispatch/materialize split -- a stray host sync inside
+    # it would close the overlap window the double-buffering opens
+    "CodecBatcher._drive",
+    "CodecBatcher._dispatch",
+    "CodecBatcher._complete",
 )
 
 # ambiguity budget: a fuzzy call edge that could hit more than this

@@ -32,10 +32,15 @@ from ..registry import Checker, register
 
 _SPAWNERS = {"create_task", "ensure_future"}
 
-# task-returning project APIs: the result carries a live task the
-# caller owns (HedgedGather entry points ride start_request; dropping
-# the tuple orphans the sub-read task)
-TASK_ROOTS = {"start_request"}
+# task-returning project APIs: the result carries a live task (or
+# reply waiters) the caller owns.  start_request: dropping the tuple
+# orphans the sub-read task (HedgedGather is the intended owner).
+# fanout_staged: the returned (tid, future) waiters ARE the commit
+# acks of the pipelined write spine -- a bare call stages sends whose
+# replies nobody ever drains (wedged waiters).  arm_flush_window: the
+# sub-op pipe's flush-window coroutine; unowned, the staged flush
+# never ships.
+TASK_ROOTS = {"start_request", "fanout_staged", "arm_flush_window"}
 
 
 @register

@@ -79,7 +79,39 @@ class Objecter:
                 except Exception:
                     pass         # retried on the next op
 
-    async def _refresh_map(self, timeout: float = 10) -> None:
+    async def _refresh_map(self, timeout: float = 10,
+                           force: bool = True) -> None:
+        """Fetch the full map -- COALESCED: concurrent callers share
+        one in-flight fetch, and (``force=False``, the op-retry path)
+        back-to-back fetches inside _REFRESH_MIN_S reuse the map we
+        just got.  During cluster churn every op attempt of every
+        client retries through here; un-coalesced, 32 clients
+        serialized a full 64-OSD map out of the mon several times per
+        second EACH, and that fetch storm (on the shared event loop)
+        was a leg of the peering-cascade collapse the degraded-phase
+        bench caught.  Explicit callers (open_ioctx after a pool
+        create, the start() subscribe) keep ``force=True``: they need
+        CURRENT state, not recent state."""
+        loop = asyncio.get_event_loop()
+        inflight = getattr(self, "_refresh_inflight", None)
+        if inflight is not None and not inflight.done():
+            await asyncio.wait_for(asyncio.shield(inflight), timeout)
+            return
+        if not force and loop.time() - getattr(self, "_refresh_at",
+                                               -1e9) \
+                < self._REFRESH_MIN_S:
+            return
+        task = loop.create_task(self._refresh_map_once(timeout))
+        self._refresh_inflight = task
+        try:
+            await task
+        finally:
+            if getattr(self, "_refresh_inflight", None) is task:
+                self._refresh_inflight = None
+
+    _REFRESH_MIN_S = 0.5
+
+    async def _refresh_map_once(self, timeout: float = 10) -> None:
         q: asyncio.Queue = asyncio.Queue()
 
         async def d(conn, msg):
@@ -92,6 +124,7 @@ class Objecter:
                                  Message("sub_osdmap", {}))
             new_map = OSDMap.from_dict(
                 await asyncio.wait_for(q.get(), timeout))
+            self._refresh_at = asyncio.get_event_loop().time()
             # a slow full-map reply must not regress past incrementals
             # _dispatch applied while we waited
             if new_map.epoch >= self.osdmap.epoch:
@@ -283,7 +316,9 @@ class Objecter:
     async def _pause_and_refresh(self) -> None:
         await asyncio.sleep(0.25)
         try:
-            await self._refresh_map(timeout=5)
+            # rate-limited: the retry storm must not serialize a full
+            # map out of the mon per attempt per client
+            await self._refresh_map(timeout=5, force=False)
         except (asyncio.TimeoutError, ConnectionError, OSError):
             pass
 

@@ -173,6 +173,21 @@ DEFAULT_SCHEMA: list[Option] = [
            "(parity' = parity XOR encode(delta)) instead of "
            "re-encoding whole stripes; unchanged data shards ship "
            "version-stamp-only sub-writes"),
+    Option("osd_pipeline_enabled", OPT_BOOL, True,
+           "pipeline the OSD write hot path: double-buffered codec "
+           "launches, commits/flushes awaited outside the PG lock "
+           "(per-(PG, object) ordering preserved), per-peer sub-op "
+           "coalescing.  The kill switch: false restores the serial "
+           "gather -> encode -> commit -> fan-out chain end to end"),
+    Option("osd_pipeline_staging_depth", OPT_INT, 4,
+           "marshaled codec batches parked between staging and "
+           "launch; a flush finding the queue full launches inline "
+           "(a counted stall), so this bounds parked host memory",
+           min=1),
+    Option("osd_pipeline_flush_window", OPT_FLOAT, 0.002,
+           "seconds the per-peer sub-op coalescer waits for "
+           "co-submitters before shipping one framed flush per peer "
+           "(drains early when the event loop goes idle)", min=0.0),
     Option("osd_heartbeat_max_peers", OPT_INT, 10,
            "heartbeat fanout cap: PG peers + id-ring neighbors "
            "instead of the O(N^2) full mesh (0 = uncapped)", min=0),

@@ -73,7 +73,9 @@ async def run_workload(spec: WorkloadSpec, *,
     conf = conf or ConfigProxy()
     t_start = time.perf_counter()
     log(f"cluster: booting mon + {spec.n_osds} osds")
-    cluster = await SimCluster.create(spec.n_osds, log=log)
+    cluster = await SimCluster.create(
+        spec.n_osds, log=log,
+        osd_config=spec.extra.get("osd_config"))
     report: dict = {"spec": spec.to_dict()}
     try:
         await _create_pool(cluster.addr, spec)
@@ -149,6 +151,7 @@ async def run_workload(spec: WorkloadSpec, *,
                 cluster.perf_counters("placement_cache")),
             "ec_batch": cluster.perf_counters("ec_batch"),
             "ec_degraded": cluster.perf_counters("ec_degraded"),
+            "ec_pipeline": cluster.perf_counters("ec_pipeline"),
         }
         report["timing"] = {
             "bringup_s": round(bringup_s, 3),

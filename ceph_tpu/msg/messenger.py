@@ -39,6 +39,15 @@ ACK_BYTES = 8 << 20
 ACK_FLUSH_S = 0.2
 ACK_TYPE = "__ack"
 
+# per-peer sub-op coalescing (the PR-12 write pipeline): concurrent
+# ops' sub-writes bound for the same peer inside one flush window ride
+# ONE framed message instead of one send per shard -- one seq, one
+# frame header, one syscall, one read-loop wakeup.  The receiver
+# unpacks and dispatches the sub-messages in staging order, so
+# per-peer FIFO (what keeps replica logs in version order) is exactly
+# as strong as the unbatched path.
+SUBOP_BATCH_TYPE = "__subop_batch"
+
 
 class Connection:
     def __init__(self, messenger: "Messenger", peer_name: str,
@@ -227,6 +236,164 @@ class Connection:
             pass
 
 
+def pack_subop_batch(msgs: list[Message]) -> Message:
+    """Fold staged sub-op messages into ONE framed flush: metas carry
+    each sub-message's (type, data, segment count); the segment lists
+    concatenate in order.  Seq/ack/replay accounting all happen on the
+    outer frame -- a reconnect replays the whole flush, the receiver
+    dedups it as one unit, and unpacking restores staging order."""
+    metas = [{"t": m.type, "d": m.data, "n": len(m.segments)}
+             for m in msgs]
+    segments: list[bytes] = []
+    for m in msgs:
+        segments.extend(m.segments)
+    return Message(SUBOP_BATCH_TYPE, {"metas": metas},
+                   segments=segments)
+
+
+def unpack_subop_batch(msg: Message) -> list[Message]:
+    out: list[Message] = []
+    off = 0
+    for meta in msg.data.get("metas", []):
+        n = int(meta.get("n", 0))
+        sub = Message(meta["t"], meta["d"],
+                      segments=list(msg.segments[off:off + n]))
+        off += n
+        sub.seq = msg.seq            # dedup identity is the frame's
+        sub.from_name = msg.from_name
+        out.append(sub)
+    return out
+
+
+class SubOpPipe:
+    """Per-peer sub-op coalescing with a flush window.
+
+    ``stage()`` parks an outbound message (synchronously -- staging
+    order IS the wire order, which is what keeps replica logs applied
+    in version order) on its peer's queue; ONE ship worker per peer
+    drains that queue, coalescing everything staged since its last
+    cycle into one ``pack_subop_batch`` frame.  The flush window is
+    an event-loop pass (the codec batcher's Nagle-off discipline) --
+    and under backpressure it widens NATURALLY: while a ship is in
+    flight the queue keeps growing, and the next cycle carries the
+    whole backlog in one frame.
+
+    Per-peer workers are a liveness requirement, not an optimization:
+    a single drain loop awaiting sends inline lets one dead peer's
+    reconnect backoff head-of-line-block every other peer's commits
+    -- observed at 64 OSDs as cluster-wide wedged ops the moment one
+    OSD died.  A slow peer now stalls only its own queue, and a send
+    failure fails exactly that peer's staged ``on_error`` hooks (the
+    op layer sees the same per-send errors as the unbatched path).
+
+    With a fault injector attached, messages ship INDIVIDUALLY: the
+    injector's drop/delay/dup rules key on the logical message type,
+    and hiding sub-ops inside a batch frame would blind the chaos
+    harness to them (the kill-mid-pipeline tests depend on per-subop
+    fault fidelity).
+    """
+
+    def __init__(self, messenger: Messenger, *,
+                 flush_window: float = 0.002, perf=None) -> None:
+        self.messenger = messenger
+        self.flush_window = float(flush_window)
+        self.perf = perf
+        # peer -> deque of (addr, msg, on_error)
+        self._peer_q: dict[str, deque] = {}
+        self._peer_tasks: dict[str, asyncio.Task] = {}
+        # peer -> a ship cycle is running (inline flush_now or the
+        # worker task); the flag is the one-shipper-per-peer mutex
+        # that keeps frames in staging order
+        self._busy: dict[str, bool] = {}
+        self._n_staged = 0
+        self.closed = False
+
+    def stage(self, addr: tuple[str, int], peer_name: str,
+              msg: Message, on_error=None) -> None:
+        """Park one sub-op send; the peer's ship worker flushes it.
+
+        Shipping ALWAYS happens on the worker task, never inline in
+        the staging caller: the op path stages while holding its PG
+        lock, and an inline send to a dead peer would hold that lock
+        across the reconnect backoff (the degraded-phase collapse the
+        pipeline exists to prevent)."""
+        if self.closed:
+            raise ConnectionError("subop pipe closed")
+        q = self._peer_q.setdefault(peer_name, deque())
+        q.append((tuple(addr), msg, on_error))
+        self._n_staged += 1
+        if self._busy.get(peer_name):
+            return               # the live ship cycle carries it
+        t = self._peer_tasks.get(peer_name)
+        if t is None or t.done():
+            self._peer_tasks[peer_name] = asyncio.ensure_future(
+                self.arm_flush_window(peer_name))
+
+    async def arm_flush_window(self, peer: str) -> None:
+        """The ship worker (one per peer, retires when the queue
+        drains; ``stage`` re-arms).  One coalescing pass first:
+        every already-runnable co-submitter stages during it."""
+        if self._busy.get(peer):
+            return
+        try:
+            if self.flush_window > 0:
+                await asyncio.sleep(0)   # co-submitters stage here
+            await self._ship_loop(peer)
+        except asyncio.CancelledError:
+            if not self._busy.get(peer):
+                await self._ship_loop(peer)   # shutdown: ship now
+
+    async def _ship_loop(self, peer: str) -> None:
+        """Ship until the peer's queue drains.  Sole shipper: the
+        _busy flag serializes cycles, so frames leave in staging
+        order even when flush_now and the worker race."""
+        q = self._peer_q.get(peer)
+        if q is None:
+            return
+        self._busy[peer] = True
+        try:
+            while q:
+                await self._ship_queued(peer, q)
+        finally:
+            self._busy[peer] = False
+
+    async def _ship_queued(self, peer: str, q: deque) -> None:
+        if not q:
+            return
+        entries = list(q)
+        q.clear()
+        self._n_staged -= len(entries)
+        if self.perf is not None:
+            self.perf.inc("flush_windows")
+        addr = entries[0][0]
+        msgs = [m for _, m, _ in entries]
+        try:
+            if len(msgs) == 1 or self.messenger.faults is not None:
+                for a, m, _ in entries:
+                    await self.messenger.send(a, peer, m)
+            else:
+                await self.messenger.send(addr, peer,
+                                          pack_subop_batch(msgs))
+                if self.perf is not None:
+                    self.perf.inc("coalesced_subops", len(msgs))
+        except (ConnectionError, OSError) as e:
+            for _, _, on_error in entries:
+                if on_error is not None:
+                    on_error(e)
+
+    async def close(self) -> None:
+        """Ship anything parked, then refuse further staging -- a
+        staged sub-op may never outlive the pipe (it would wedge the
+        op awaiting its reply)."""
+        self.closed = True
+        for t in self._peer_tasks.values():
+            t.cancel()
+        self._peer_tasks.clear()
+        for peer, q in list(self._peer_q.items()):
+            if not self._busy.get(peer):
+                await self._ship_queued(peer, q)
+
+
 class Messenger:
     def __init__(self, name: str, secret: bytes | None = None, *,
                  max_unacked_msgs: int = 4096,
@@ -275,6 +442,14 @@ class Messenger:
         self.ticket_validator = None
         self.require_ticket = False
         self.dispatchers: list[Dispatcher] = []
+        # ms_fast_dispatch analog: a SYNCHRONOUS handler consulted
+        # before the task-per-message dispatch path.  Returning True
+        # consumes the message without spawning a task -- reply
+        # messages that only resolve a tid waiter (the bulk of sub-op
+        # traffic) skip a whole scheduling quantum each.  Fault
+        # delays/duplicates still take the task path so chaos timing
+        # semantics are unchanged.
+        self.fast_dispatch = None
         # one connection per peer per DIRECTION: simultaneous cross-
         # connects between two daemons are legal and never race over a
         # shared slot (the reference arbitrates the same race with
@@ -655,28 +830,13 @@ class Messenger:
                 if not conn.outgoing:
                     self._sessions[conn.peer_name] = msg.seq
                 conn._note_delivered(len(buf))
-                copies, delay = 1, 0.0
-                if self.faults is not None:
-                    # recv-side injection happens ABOVE the transport:
-                    # seq/ack accounting already ran, so a dropped
-                    # message is "lost in the daemon", not a wire error
-                    # the lossless replay would transparently heal
-                    fd = self.faults.on_recv(
-                        self.name, conn.peer_name or msg.from_name,
-                        msg.type)
-                    if fd.drop:
-                        continue
-                    copies, delay = fd.copies, fd.delay
-                # dispatch in a task: a handler that itself RPCs back to
-                # this peer must not block the read loop its reply rides
-                # on (the reference's DispatchQueue decoupling).  Task
-                # creation order preserves ordering for handlers'
-                # synchronous prefixes.
-                for _ in range(copies):
-                    t = asyncio.ensure_future(
-                        self._dispatch_one(conn, msg, delay))
-                    self._accept_tasks.add(t)
-                    t.add_done_callback(self._accept_tasks.discard)
+                if msg.type == SUBOP_BATCH_TYPE:
+                    # one framed flush -> the staged sub-ops, delivered
+                    # in staging order (per-peer FIFO preserved)
+                    for sub in unpack_subop_batch(msg):
+                        self._deliver(conn, sub)
+                else:
+                    self._deliver(conn, msg)
         except (asyncio.IncompleteReadError, ConnectionError, ValueError):
             if conn.outgoing and not conn.closed:
                 # lossless policy: try to re-establish and replay
@@ -700,6 +860,35 @@ class Messenger:
                     pass
         except asyncio.CancelledError:
             pass
+
+    def _deliver(self, conn: Connection, msg: Message) -> None:
+        """Fault-inject and dispatch ONE logical message (seq/ack
+        accounting already ran on its frame)."""
+        copies, delay = 1, 0.0
+        if self.faults is not None:
+            # recv-side injection happens ABOVE the transport:
+            # seq/ack accounting already ran, so a dropped
+            # message is "lost in the daemon", not a wire error
+            # the lossless replay would transparently heal
+            fd = self.faults.on_recv(
+                self.name, conn.peer_name or msg.from_name,
+                msg.type)
+            if fd.drop:
+                return
+            copies, delay = fd.copies, fd.delay
+        if (self.fast_dispatch is not None and copies == 1
+                and delay == 0.0 and self.fast_dispatch(conn, msg)):
+            return
+        # dispatch in a task: a handler that itself RPCs back to
+        # this peer must not block the read loop its reply rides
+        # on (the reference's DispatchQueue decoupling).  Task
+        # creation order preserves ordering for handlers'
+        # synchronous prefixes.
+        for _ in range(copies):
+            t = asyncio.ensure_future(
+                self._dispatch_one(conn, msg, delay))
+            self._accept_tasks.add(t)
+            t.add_done_callback(self._accept_tasks.discard)
 
     async def _try_reconnect(self, conn: Connection) -> None:
         try:

@@ -185,6 +185,19 @@ class PGBackend:
     def __init__(self, pg) -> None:
         self.pg = pg
         self.osd = pg.osd
+        # pipelined write spine (PR 12): when on, submit_transaction
+        # stages its sub-op sends through the per-peer coalescing pipe
+        # and RETURNS the commit wait instead of awaiting it -- the PG
+        # releases its lock before awaiting, so the next op's
+        # gather/encode/store phases overlap this op's peer round
+        # trip.  Snapshot at construction (hot-path-config-read).
+        self._pipeline = self._cfg("osd_pipeline_enabled", True)
+
+    def _cfg(self, name: str, default):
+        cfg = getattr(self.osd, "config", None)
+        if not isinstance(cfg, dict):
+            return default
+        return type(default)(cfg.get(name, default))
 
     @property
     def store(self):
@@ -245,6 +258,12 @@ class PGBackend:
         if not awaiting:
             return
         replies = await self.osd.fanout_and_wait(awaiting, collect=True)
+        self._heal_laggards(awaiting, replies, entry)
+
+    def _heal_laggards(self, awaiting, replies, entry: LogEntry) -> None:
+        """The all-commit accounting tail shared by the serial and
+        pipelined fan-outs: record laggards missing, kick recovery,
+        error below min_size."""
         acked = {r.data.get("from_osd") for r in replies}
         laggards = [t[0] for t in awaiting if t[0] not in acked]
         if not laggards:
@@ -258,6 +277,48 @@ class PGBackend:
             raise TimeoutError(
                 f"{entry.oid}: only {n_committed} commits < min_size "
                 f"{self.pg.pool.min_size} (laggards {laggards})")
+
+    def _start_commits(self, awaiting, entry: LogEntry):
+        """Deferred all-commit fan-out, the pipelined half of
+        ``_fanout_commits``: stage every sub-op send NOW -- staging is
+        synchronous, so the per-peer wire order is the submit order
+        (replica logs apply in version order) -- and return a Task
+        that resolves when the commits land, with the same laggard
+        healing and min_size semantics.  None when the pipeline is
+        off (kill switch) or the coalescing pipe is not up."""
+        pipe = getattr(self.osd, "subop_pipe", None)
+        if not self._pipeline or pipe is None or pipe.closed:
+            return None
+        futs = self.osd.fanout_staged(awaiting)
+
+        async def _commit():
+            replies = await self.osd.await_staged(futs, collect=True)
+            self._heal_laggards(awaiting, replies, entry)
+
+        # a bare coroutine, not a task: PG._chain_commit wraps it in
+        # the ONE per-write ordering task (two tasks per write is
+        # measurable overhead on a saturated loop)
+        return _commit()
+
+    async def _commit_or_defer(self, awaiting, entry: LogEntry):
+        """Serial chain (await the fan-out under the caller) or
+        pipelined chain (return the commit wait for the PG to await
+        OUTSIDE its lock).  The two paths share the send payloads and
+        the healing tail; only WHERE the await happens differs.
+
+        The staged sends deliberately ship from the pipe's per-peer
+        workers, NOT inline here: an inline send runs under the PG
+        lock, and a dead peer's reconnect backoff would hold the lock
+        across it -- measured at 64 OSDs as the degraded phase
+        collapsing into wedged ops (the serial chain's exact failure
+        mode, reintroduced).  The one scheduling pass a worker costs
+        is the price of keeping peer death out of the lock."""
+        if not awaiting:
+            return None
+        commit = self._start_commits(awaiting, entry)
+        if commit is None:
+            await self._fanout_commits(awaiting, entry)
+        return commit
 
 
 def build_pg_backend(pg):
@@ -301,7 +362,7 @@ class ReplicatedBackend(PGBackend):
                                  "entry": entry.to_dict(),
                                  "muts": [], "log_only": True,
                                  **tr}, []))
-        await self._fanout_commits(targets, entry)
+        return await self._commit_or_defer(targets, entry)
 
     def apply_rep_op(self, entry: LogEntry, muts: list[dict],
                      log_only: bool = False) -> None:
@@ -403,12 +464,6 @@ class ECBackend(PGBackend):
     def _count(self, key: str, by: int = 1) -> None:
         if self.perf_degraded is not None:
             self.perf_degraded.inc(key, by)
-
-    def _cfg(self, name: str, default):
-        cfg = getattr(self.osd, "config", None)
-        if not isinstance(cfg, dict):
-            return default
-        return type(default)(cfg.get(name, default))
 
     @property
     def batcher(self):
@@ -950,15 +1005,13 @@ class ECBackend(PGBackend):
                                "attr_muts": attr_meta}
                     awaiting.append((osd, "ec_subop_write", payload,
                                      attr_segs))
-            if awaiting:
-                await self._fanout_commits(awaiting, entry)
-            return
+            return await self._commit_or_defer(awaiting, entry)
         old_size = await self.object_size(entry.oid)
         plan = self._plan_rmw(content_muts, old_size)
         if plan is not None:
-            await self._submit_partial(entry, content_muts, attr_muts,
-                                       old_size, *plan)
-            return
+            return await self._submit_partial(entry, content_muts,
+                                              attr_muts, old_size,
+                                              *plan)
         logical = bytearray(await self._read_logical(entry.oid))
         remove = False          # tracks the FINAL state: a remove followed
         for m in content_muts:  # by a write recreates the object in-order
@@ -1039,8 +1092,7 @@ class ECBackend(PGBackend):
                 segs = (segs_per_shard[shard]
                         + pack_mutations(attr_muts)[1])
                 awaiting.append((osd, "ec_subop_write", payload, segs))
-        if awaiting:
-            await self._fanout_commits(awaiting, entry)
+        return await self._commit_or_defer(awaiting, entry)
 
     # -- partial-stripe RMW pipeline ----------------------------------------
     # The reference's RMWPipeline (ECCommon.cc:704 start_rmw ->
@@ -1278,8 +1330,7 @@ class ECBackend(PGBackend):
                            "w": w, "attr_muts": attr_meta}
                 awaiting.append((osd, "ec_subop_write", payload,
                                  segs + attr_segs))
-        if awaiting:
-            await self._fanout_commits(awaiting, entry)
+        return await self._commit_or_defer(awaiting, entry)
 
     def apply_sub_write(self, entry: LogEntry, w: dict,
                         segs: list[bytes], attr_muts: list[dict],

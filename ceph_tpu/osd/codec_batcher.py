@@ -42,11 +42,24 @@ Mechanics:
     every visible device with donated stripe buffers and the CRC
     side-path fused into the same program -- a single device is just
     a 1-device mesh, so the code path is identical from laptop CPU to
-    a full slice.
+    a full slice;
+  * the launch spine is DOUBLE-BUFFERED (the PR-12 write pipeline):
+    a flush marshals its batch on host (pad, stack, stage) and hands
+    it to a single-slot launch driver instead of launching inline, so
+    batch N+1's host staging overlaps launch N's device time -- the
+    dispatch/materialize split (``out_np=False`` launches, one
+    ``np.asarray`` at completion) is what opens the window, and the
+    donation contracts from the mesh path already make the buffer
+    handoff safe.  ``osd_pipeline_enabled=false`` is the kill switch
+    that restores the serial marshal->launch->fan-out chain (the
+    parity oracle: both paths are the same three functions, only the
+    interleaving differs).
 
 Occupancy is surfaced as perf counters (``perf dump`` -> "ec_batch"):
 batches launched, a stripes-per-batch histogram, padding waste, and
 flush-reason counts, so the bench can report achieved batch sizes.
+Pipeline occupancy (staged batches, overlap windows, staging-full
+stalls) lands in the OSD-wide "ec_pipeline" set.
 """
 
 from __future__ import annotations
@@ -84,6 +97,28 @@ class _Group:
         self.task: asyncio.Task | None = None
 
 
+class _Staged:
+    """One marshaled batch parked between staging and launch: the
+    host work (padding, stacking, CRC wants) is DONE; only the device
+    dispatch and the post-launch fan-out remain."""
+
+    __slots__ = ("grp", "reason", "batch", "old_batch", "want_crc",
+                 "lane", "total", "b", "payload", "mesh")
+
+    def __init__(self, grp, reason, batch, old_batch, want_crc,
+                 lane, total, b, payload, mesh) -> None:
+        self.grp = grp
+        self.reason = reason
+        self.batch = batch
+        self.old_batch = old_batch
+        self.want_crc = want_crc
+        self.lane = lane
+        self.total = total
+        self.b = b
+        self.payload = payload
+        self.mesh = mesh
+
+
 class CodecBatcher:
     """Asyncio micro-batching stage for EC codec launches.
 
@@ -99,11 +134,24 @@ class CodecBatcher:
                  flush_timeout: float = 0.002,
                  eager_flush: bool = True, perf=None,
                  mesh="auto", mesh_devices: int = 0,
-                 mesh_donate: bool = True) -> None:
+                 mesh_donate: bool = True,
+                 pipeline: bool = True, staging_depth: int = 4,
+                 pipe_perf=None) -> None:
         self.max_batch = max(1, int(max_batch))
         self.flush_timeout = float(flush_timeout)
         self.eager_flush = bool(eager_flush)
         self.perf = perf
+        # double-buffered launch spine: staged batches queue here and
+        # a single driver task launches them, so the NEXT batch's host
+        # marshal overlaps the current launch's device time.  Depth
+        # bounds parked host memory; a flush finding the queue full
+        # launches inline (a counted stall, never an unbounded queue).
+        self.pipeline = bool(pipeline)
+        self.staging_depth = max(1, int(staging_depth))
+        self.pipe_perf = pipe_perf
+        from collections import deque
+        self._staged: deque[_Staged] = deque()
+        self._drive_task: asyncio.Task | None = None
         # sharded data plane (parallel/mesh_codec.py): "auto" builds a
         # MeshCodec over the visible devices LAZILY on the first
         # mesh-eligible launch (a replicated-only OSD never pays the
@@ -121,10 +169,11 @@ class CodecBatcher:
             perf.hist_register("stripes_per_batch", STRIPE_HIST_BUCKETS)
 
     @classmethod
-    def from_config(cls, conf, perf=None) -> "CodecBatcher | None":
-        """Construction-time snapshot of every batcher/mesh knob (the
-        hot launch loop must never call ``conf.get``).  Returns None
-        when EC batching is disabled."""
+    def from_config(cls, conf, perf=None,
+                    pipe_perf=None) -> "CodecBatcher | None":
+        """Construction-time snapshot of every batcher/mesh/pipeline
+        knob (the hot launch loop must never call ``conf.get``).
+        Returns None when EC batching is disabled."""
         if not conf.get("osd_ec_batch_enabled", True):
             return None
         return cls(
@@ -137,7 +186,10 @@ class CodecBatcher:
                   else None),
             mesh_devices=int(conf.get("osd_ec_mesh_devices", 0)),
             mesh_donate=bool(conf.get("osd_ec_mesh_donate", True)),
-            perf=perf)
+            pipeline=bool(conf.get("osd_pipeline_enabled", True)),
+            staging_depth=int(conf.get("osd_pipeline_staging_depth",
+                                       4)),
+            perf=perf, pipe_perf=pipe_perf)
 
     def _mesh_for(self, codec):
         """The sharded launch engine for this codec, or None (then the
@@ -270,7 +322,75 @@ class CodecBatcher:
         grp = self._groups.pop(key, None)
         if grp is None or not grp.items:
             return
-        self._run_batch(grp, reason)
+        if not self.pipeline or self._closed:
+            self._run_batch(grp, reason)
+            return
+        # pipelined: marshal NOW (this is exactly the host staging that
+        # overlaps the in-flight launch), park the batch, and let the
+        # driver launch it.  A full staging queue degrades to an inline
+        # launch -- bounded memory, and the stall is counted so the
+        # bench can see when the depth knob binds.
+        if len(self._staged) >= self.staging_depth:
+            self._pcount("stage_stalls")
+            self._run_batch(grp, reason)
+            return
+        self._staged.append(self._marshal(grp, reason))
+        self._pcount("staged_batches")
+        if self._drive_task is None or self._drive_task.done():
+            self._drive_task = asyncio.ensure_future(self._drive())
+
+    def _pcount(self, key: str, by: int = 1) -> None:
+        if self.pipe_perf is not None:
+            self.pipe_perf.inc(key, by)
+
+    async def _drive(self) -> None:
+        """The staged launch driver: one in-flight launch at a time.
+
+        Dispatch is asynchronous (``out_np=False`` launches return
+        device futures), so the yield between dispatch and completion
+        is the overlap window -- co-submitting tasks run there and
+        marshal batch N+1 while N executes on device."""
+        while self._staged:
+            st = self._staged.popleft()
+            try:
+                handle = self._dispatch(st)
+            except Exception as e:
+                self._fail(st, e)
+                continue
+            # overlap window: let submitters stage the next batch
+            # while this launch is in flight on device.  Only yield
+            # when someone could actually use the window (a parked
+            # batch or a coalescing group) -- an unconditional yield
+            # would add a scheduling pass to EVERY launch completion,
+            # which under a saturated loop is pure latency.
+            if self._staged or self._groups:
+                await asyncio.sleep(0)
+            if self._staged:
+                self._pcount("inflight_overlap_windows")
+            try:
+                self._complete(st, handle)
+            except Exception as e:
+                self._fail(st, e)
+
+    def _drain_staged(self) -> None:
+        """Synchronously launch everything parked (shutdown path): no
+        staged batch may outlive the batcher -- an orphaned batch is a
+        wedged op."""
+        if self._drive_task is not None:
+            self._drive_task.cancel()
+            self._drive_task = None
+        while self._staged:
+            st = self._staged.popleft()
+            try:
+                self._complete(st, self._dispatch(st))
+            except Exception as e:
+                self._fail(st, e)
+
+    @staticmethod
+    def _fail(st: "_Staged", e: Exception) -> None:
+        for _, fut, _, _ in st.grp.items:
+            if not fut.done():
+                fut.set_exception(e)
 
     def flush_all(self, reason: str = "close") -> None:
         for key in list(self._groups):
@@ -281,13 +401,18 @@ class CodecBatcher:
         refuse further coalescing (stragglers launch solo)."""
         self._closed = True
         self.flush_all("close")
+        self._drain_staged()
 
     # -- the launch ----------------------------------------------------------
     def _launch_one(self, kind: str, codec, extra: tuple,
-                    arr: np.ndarray):
+                    arr: np.ndarray, out_np: bool = True):
         if kind == "encode":
+            if not out_np:      # deferred: one asarray at completion
+                return codec.encode_batch(arr, out_np=False)
             # lint: disable=device-path-host-sync -- the single post-launch materialization (out_np=True: already host)
             return np.asarray(codec.encode_batch(arr, out_np=True))
+        if not out_np:
+            return codec.decode_batch(list(extra), arr, out_np=False)
         # lint: disable=device-path-host-sync -- the single post-launch materialization (out_np=True: already host)
         return np.asarray(codec.decode_batch(list(extra), arr,
                                              out_np=True))
@@ -306,6 +431,21 @@ class CodecBatcher:
                                crcs[b * k:].reshape(b, r)], axis=1)
 
     def _run_batch(self, grp: _Group, reason: str) -> None:
+        """The serial chain (kill-switch path and shutdown drain):
+        marshal -> dispatch -> complete inline.  The pipelined driver
+        runs the SAME three functions with a yield between dispatch
+        and complete -- byte parity between the two modes is by
+        construction, not by test luck."""
+        st = self._marshal(grp, reason)
+        try:
+            self._complete(st, self._dispatch(st))
+        except Exception as e:
+            self._fail(st, e)
+
+    def _marshal(self, grp: _Group, reason: str) -> _Staged:
+        """Host staging: pad and stack the coalesced submissions into
+        one (b, k, lane) launch batch (plus the old-parity batch for
+        rmw).  This is the work that overlaps the in-flight launch."""
         # lazy: gf2kernels pulls in jax, which a replicated-only OSD
         # must not pay for at boot (only EC submissions reach here,
         # and by then the codec itself has loaded the stack)
@@ -342,7 +482,17 @@ class CodecBatcher:
                     old_batch[row:row + n, :, :l] = old
                     row += n
         want_crc = any(w for _, _, w, _ in items)
-        crcs = None
+        return _Staged(grp, reason, batch, old_batch, want_crc,
+                       lane, total, b, payload, mesh)
+
+    def _dispatch(self, st: _Staged) -> tuple:
+        """Device dispatch WITHOUT materialization: launches return
+        device futures (``out_np=False``), so control comes back to
+        the event loop while the device works.  Returns
+        (mode, out, crcs, xor_stats0); ``_complete`` pays the single
+        asarray."""
+        grp, batch, old_batch = st.grp, st.batch, st.old_batch
+        want_crc, mesh = st.want_crc, st.mesh
         # scheduled-engine observability: the XOR-schedule compiler
         # (ops/xor_schedule.py) counts process-wide; sampling the
         # delta around THIS launch keeps the ec_batch counters live
@@ -351,62 +501,70 @@ class CodecBatcher:
         if self.perf is not None:
             from ..ops.xor_schedule import STATS as XOR_STATS
             xor_stats0 = XOR_STATS.snapshot()
-        try:
-            out = None
-            if mesh is not None:
-                # the sharded data plane: ONE launch for the whole
-                # coalesced batch, partitioned over every mesh device,
-                # fused CRCs riding the same launch when wanted.  A
-                # mesh failure degrades to the single-device ladder
-                # below instead of failing every waiter.
-                try:
-                    if grp.kind == "rmw":
-                        out = mesh.rmw(grp.codec, old_batch, batch)
-                    elif grp.kind == "encode" and want_crc \
-                            and self._fused_crc_ok():
-                        out, crcs = mesh.encode(grp.codec, batch,
-                                                with_crc=True)
-                        if self.perf is not None:
-                            self.perf.inc("crc_fused_launches")
-                    elif grp.kind == "encode":
-                        out = mesh.encode(grp.codec, batch)
-                        if want_crc:
-                            crcs = self._host_chunk_crcs(batch, out)
-                            if self.perf is not None:
-                                self.perf.inc("crc_host_batches")
-                    else:
-                        out = mesh.decode(grp.codec, grp.extra, batch)
-                except Exception:
-                    out = crcs = None
+        out = crcs = None
+        if mesh is not None:
+            # the sharded data plane: ONE launch for the whole
+            # coalesced batch, partitioned over every mesh device,
+            # fused CRCs riding the same launch when wanted.  A
+            # mesh failure degrades to the single-device ladder
+            # below instead of failing every waiter.
+            try:
+                if grp.kind == "rmw":
+                    out = mesh.rmw(grp.codec, old_batch, batch,
+                                   out_np=False)
+                elif grp.kind == "encode" and want_crc \
+                        and self._fused_crc_ok():
+                    out, crcs = mesh.encode(grp.codec, batch,
+                                            with_crc=True,
+                                            out_np=False)
                     if self.perf is not None:
-                        self.perf.inc("mesh_fallbacks")
-            if out is not None:
-                pass
-            elif grp.kind == "rmw":
-                # single-device delta: parity' = parity ^ encode(delta)
-                out = old_batch ^ self._launch_one("encode", grp.codec,
-                                                   (), batch)
-            elif want_crc and grp.kind == "encode" \
-                    and hasattr(grp.codec, "encode_batch_crc") \
-                    and self._fused_crc_ok():
-                out, crcs = grp.codec.encode_batch_crc(batch)
-                # lint: disable=device-path-host-sync -- the single post-launch materialization of the fused launch
-                out = np.asarray(out)
+                        self.perf.inc("crc_fused_launches")
+                elif grp.kind == "encode":
+                    out = mesh.encode(grp.codec, batch, out_np=False)
+                else:
+                    out = mesh.decode(grp.codec, grp.extra, batch,
+                                      out_np=False)
+            except Exception:
+                out = crcs = None
                 if self.perf is not None:
-                    self.perf.inc("crc_fused_launches")
-            else:
-                out = self._launch_one(grp.kind, grp.codec, grp.extra,
-                                       batch)
-                if want_crc:
-                    crcs = self._host_chunk_crcs(batch, out)
-                    if self.perf is not None:
-                        self.perf.inc("crc_host_batches")
-        except Exception as e:
-            for _, fut, _, _ in items:
-                if not fut.done():
-                    fut.set_exception(e)
-            return
+                    self.perf.inc("mesh_fallbacks")
+        if out is not None:
+            return ("plain", out, crcs, xor_stats0)
+        if grp.kind == "rmw":
+            # single-device delta: parity' = parity ^ encode(delta),
+            # the XOR applied at completion on the materialized encode
+            enc = self._launch_one("encode", grp.codec, (), batch,
+                                   out_np=False)
+            return ("rmw_host", enc, None, xor_stats0)
+        if want_crc and grp.kind == "encode" \
+                and hasattr(grp.codec, "encode_batch_crc") \
+                and self._fused_crc_ok():
+            out, crcs = grp.codec.encode_batch_crc(batch)
+            if self.perf is not None:
+                self.perf.inc("crc_fused_launches")
+            return ("plain", out, crcs, xor_stats0)
+        out = self._launch_one(grp.kind, grp.codec, grp.extra, batch,
+                               out_np=False)
+        return ("plain", out, crcs, xor_stats0)
+
+    def _complete(self, st: _Staged, handle: tuple) -> None:
+        """Materialize the launch (the single post-launch host hop),
+        fan results back to the per-op futures, bump the counters."""
+        grp, items = st.grp, st.grp.items
+        mode, out, crcs, xor_stats0 = handle
+        # lint: disable=device-path-host-sync -- the single post-launch materialization
+        out = np.asarray(out)
+        if mode == "rmw_host":
+            out = st.old_batch ^ out
+        if crcs is not None:
+            # lint: disable=device-path-host-sync -- the single post-launch materialization (fused CRC side output)
+            crcs = np.asarray(crcs)
+        elif st.want_crc:
+            crcs = self._host_chunk_crcs(st.batch, out)
+            if self.perf is not None:
+                self.perf.inc("crc_host_batches")
         row = 0
+        lane = st.lane
         for a, fut, w, _ in items:
             n, _, l = a.shape
             if not fut.done():
@@ -427,11 +585,12 @@ class CodecBatcher:
         if self.perf is not None:
             self.perf.inc("batches")
             self.perf.inc(f"{grp.kind}_launches")
-            self.perf.inc("stripes", total)
+            self.perf.inc("stripes", st.total)
             self.perf.inc("ops_coalesced", len(items))
-            self.perf.inc("pad_waste_bytes", b * k * lane - payload)
-            self.perf.inc(f"flush_{reason}")
-            self.perf.hist_sample("stripes_per_batch", total)
+            self.perf.inc("pad_waste_bytes",
+                          st.b * st.batch.shape[1] * lane - st.payload)
+            self.perf.inc(f"flush_{st.reason}")
+            self.perf.hist_sample("stripes_per_batch", st.total)
             if xor_stats0 is not None:
                 from ..ops.xor_schedule import STATS as XOR_STATS
                 l1, f1, t1 = XOR_STATS.snapshot()

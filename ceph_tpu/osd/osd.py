@@ -142,6 +142,24 @@ class OSD:
         # batched vs scalar call mix
         from ..ops.crc32c_batch import PERF as _integrity_perf
         self.perf.adopt(_integrity_perf)
+        # write-pipeline observability ("ec_pipeline" perf set): the
+        # double-buffered batcher (staged_batches, overlap windows,
+        # stage stalls), the deferred commit path (commit_overlap_ms)
+        # and the per-peer sub-op coalescer (coalesced_subops,
+        # flush_windows) all report here.  Pipeline knobs are SNAPSHOT
+        # at construction -- the kill switch osd_pipeline_enabled=false
+        # restores the serial chain end to end.
+        self.perf_pipeline = self.perf.create("ec_pipeline")
+        for key in ("staged_batches", "inflight_overlap_windows",
+                    "stage_stalls", "overlapped_commits",
+                    "commit_overlap_ms", "coalesced_subops",
+                    "flush_windows"):
+            self.perf_pipeline.inc(key, 0)    # visible even when idle
+        self.pipeline_enabled = bool(
+            self.config.get("osd_pipeline_enabled", True))
+        self._pipeline_flush_window = float(
+            self.config.get("osd_pipeline_flush_window", 0.002))
+        self.subop_pipe = None       # built in start() (needs msgr)
         # cross-PG EC codec aggregation stage: every ECBackend on this
         # OSD funnels encode/decode work through ONE batcher so
         # concurrent ops share accelerator launches
@@ -150,7 +168,8 @@ class OSD:
         # snapshot here, once: the launch loop never reads config
         from .codec_batcher import CodecBatcher
         self.codec_batcher = CodecBatcher.from_config(
-            self.config, perf=self.perf.create("ec_batch"))
+            self.config, perf=self.perf.create("ec_batch"),
+            pipe_perf=self.perf_pipeline)
         # device-resident shard cache (os/device_cache.py): hot shard
         # buffers stay resident across encode -> commit -> read-verify
         # -> scrub -> decode instead of round-tripping the store.
@@ -219,6 +238,16 @@ class OSD:
                               faults=self.faults,
                               **(self.msgr_opts or {}))
         self.msgr.add_dispatcher(self._dispatch)
+        self.msgr.fast_dispatch = self.fast_dispatch
+        if self.pipeline_enabled:
+            # per-peer sub-op coalescing (msg/messenger.py SubOpPipe):
+            # concurrent ops' sub-writes to one peer share a framed
+            # flush per window instead of one send per shard
+            from ..msg.messenger import SubOpPipe
+            self.subop_pipe = SubOpPipe(
+                self.msgr,
+                flush_window=self._pipeline_flush_window,
+                perf=self.perf_pipeline)
         addr = await self.msgr.bind(host, port)
         ack = await self._mon_request(
             "osd_boot", {"uuid": self.uuid, "host": self.host,
@@ -334,6 +363,11 @@ class OSD:
         self._stopped = True
         if self.codec_batcher is not None:
             self.codec_batcher.close()
+        if self.subop_pipe is not None:
+            # ship staged sub-ops before the messenger dies: a parked
+            # flush would wedge every op awaiting its replies
+            await self.subop_pipe.close()
+            self.subop_pipe = None
         if self.admin_socket is not None:
             await self.admin_socket.stop()
         for t in list(self._tasks):
@@ -405,6 +439,18 @@ class OSD:
 
     # -- map handling -------------------------------------------------------
     def _apply_full_map(self, map_dict: dict) -> None:
+        # steady-state dedupe: epochs are monotonic per change, so a
+        # full map at an epoch we already hold is byte-for-byte the
+        # map we have -- re-ingesting it would rebuild the placement
+        # cache and sweep every PG for nothing.  The heartbeat's
+        # map-freshness probe refetches the full map every few quiet
+        # seconds per OSD; before this guard that re-ingest was the
+        # single largest steady-state CPU line in the cluster bench
+        # (the op loop starved under its own liveness probes).
+        if int(map_dict.get("epoch", 0)) <= self.osdmap.epoch \
+                and self.osdmap.epoch > 0:
+            self._last_map_time = time.monotonic()
+            return
         # capture the outgoing table: delta() against it lets the new
         # map touch only the PGs that actually moved
         prev = self.osdmap.peek_placement_cache()
@@ -688,6 +734,45 @@ class OSD:
             except (ConnectionError, OSError) as e:
                 if not fut.done():
                     fut.set_exception(ConnectionError(str(e)))
+        return await self.await_staged(futs, collect=collect,
+                                       timeout=timeout)
+
+    def fanout_staged(self, requests) -> list:
+        """Stage (osd, type, data, segments) sub-op sends through the
+        per-peer coalescing pipe and return the (tid, future) reply
+        waiters for ``await_staged``.
+
+        Staging is SYNCHRONOUS (no await between requests): staging
+        order is the per-peer wire order, which is what keeps replica
+        logs applied in version order when commits overlap.  The
+        caller owns the reply futures -- a bare call orphans them
+        (the dropped-task lint roots this entry point)."""
+        pipe = self.subop_pipe
+        futs = []
+        for osd, mtype, data, segments in requests:
+            tid = next(self._tid)
+            fut = asyncio.get_event_loop().create_future()
+            self._waiters[tid] = fut
+            futs.append((tid, fut))
+            d = dict(data)
+            d["tid"] = tid
+
+            def on_error(e, fut=fut):
+                if not fut.done():
+                    fut.set_exception(ConnectionError(str(e)))
+
+            try:
+                pipe.stage(self._peer_addr(osd), f"osd.{osd}",
+                           Message(mtype, d, segments=list(segments)),
+                           on_error=on_error)
+            except (ConnectionError, OSError) as e:
+                on_error(e)
+        return futs
+
+    async def await_staged(self, futs, collect: bool = False,
+                           timeout: float = 10):
+        """Await the (tid, future) reply waiters of a staged fan-out
+        (shared wait tail of fanout_and_wait)."""
         try:
             if futs:
                 done, pending = await asyncio.wait(
@@ -719,6 +804,26 @@ class OSD:
         if fut is not None and not fut.done():
             fut.set_result(msg)
 
+    # reply types whose whole handler is the synchronous tid
+    # resolution above: they take the messenger's fast-dispatch path
+    # (no task per message) -- the bulk of sub-op traffic on the
+    # pipelined write spine is exactly these
+    _FAST_REPLIES = frozenset((
+        "rep_op_reply", "ec_subop_write_reply", "ec_subop_read_reply",
+        "pg_pull_reply", "pg_push_reply", "scrub_release_ack"))
+
+    def fast_dispatch(self, conn, msg: Message) -> bool:
+        """Synchronous fast path consulted by the messenger before
+        spawning a dispatch task; True = consumed."""
+        t = msg.type
+        if t in self._FAST_REPLIES:
+            self._resolve_tid(msg)
+            return True
+        if t == "osd_ping_reply":
+            self._hb_last[msg.data["from_osd"]] = time.monotonic()
+            return True
+        return False
+
     # -- dmclock admission --------------------------------------------------
     async def admit(self, op_class: OpClass):
         fut = asyncio.get_event_loop().create_future()
@@ -746,7 +851,21 @@ class OSD:
     async def _heartbeat_loop(self) -> None:
         try:
             while True:
-                await asyncio.sleep(self.config["osd_heartbeat_interval"])
+                interval = self.config["osd_heartbeat_interval"]
+                t0 = time.monotonic()
+                await asyncio.sleep(interval)
+                # scheduling-lag credit: if OUR sleep woke late, the
+                # event loop was starved -- and peers sharing it were
+                # equally starved, not silent.  Crediting the clocks
+                # keeps loop congestion (peering bursts, recovery
+                # storms) from reading as peer death; false failure
+                # reports during a real failure are how one kill
+                # cascades into a cluster-wide peering storm (the
+                # degraded-phase collapse the bench caught).
+                late = time.monotonic() - t0 - interval
+                if late > 0.2:
+                    for osd in self._hb_last:
+                        self._hb_last[osd] += late
                 await self._heartbeat_once()
         except asyncio.CancelledError:
             pass

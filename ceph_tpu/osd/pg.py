@@ -29,6 +29,12 @@ from .types import (
 LOG_CAP = 512           # entries kept in the in-memory/persisted log
 SCAN_BATCH = 128        # objects per pg_scan page / backfill batch
 
+
+def _log_key(v: EVersion) -> str:
+    """Per-entry omap key for the PG log; zero-padded so the plain
+    lexicographic omap order IS (epoch, version) order."""
+    return f"log.{v.epoch:010d}.{v.version:012d}"
+
 # client op names that mutate
 WRITE_OPS = {"create", "write", "writefull", "append", "truncate", "zero",
              "remove", "setxattr", "rmxattr", "omap_set", "omap_rm",
@@ -76,6 +82,11 @@ class PG:
         # transition trace for introspection/tests (NamedState events)
         self.state_history: list[str] = ["initial"]
         self.lock = asyncio.Lock()
+        # pipelined write spine (PR 12): per-object chains of deferred
+        # commit tasks.  A write's peer fan-out is awaited OUTSIDE the
+        # PG lock; ordering per (PG, object) is preserved by chaining
+        # commits per oid and gating the next op on the chain head.
+        self._obj_commits: dict[str, asyncio.Task] = {}
         self._recovery_task: asyncio.Task | None = None
         self._peering_task: asyncio.Task | None = None
         self._completed_reqids: dict[tuple[str, int], EVersion] = {}
@@ -89,6 +100,17 @@ class PG:
         self.watchers: dict[str, dict[tuple, dict]] = {}
         self.trimmed_snaps: set[int] = set()
         self._snap_trim_task: asyncio.Task | None = None
+        # incremental log persistence (the PR-12 store-txn hot path):
+        # entries live as individual ``log.<epoch>.<version>`` omap
+        # keys, so a write persists ONE new entry (+ trims) instead of
+        # re-encoding the whole capped log -- at LOG_CAP=512 the
+        # monolithic blob cost ~6ms of denc per shard per write, the
+        # single largest CPU line of the cluster bench's write path.
+        # _log_keys mirrors what the store holds; _log_dirty forces a
+        # full rewrite after wholesale log surgery (peering merges).
+        self._log_keys: set[str] = set()
+        self._log_dirty = False
+        self._legacy_log_key = False
         if not self.osd.store.collection_exists(self.coll):
             txn = Transaction()
             txn.create_collection(self.coll)
@@ -122,11 +144,34 @@ class PG:
                    PGInfo.from_dict)
         if got is not None:
             self.info = got
-        got = load("log", lambda r: PGLog.dedenc(Decoder(r)),
-                   PGLog.from_dict)
-        if got is not None:
-            self.log = got
+        log_keys = {k: v for k, v in omap.items()
+                    if k.startswith("log.")}
+        if log_keys:
+            # per-entry format: lexicographic key order is version
+            # order by construction
+            entries = [LogEntry.dedenc(Decoder(raw))
+                       for _, raw in sorted(log_keys.items())]
+            tail = head = ZERO
+            lm = omap.get("logmeta")
+            if lm:
+                t, h = json.loads(lm)
+                tail = EVersion.from_list(t)
+                head = EVersion.from_list(h)
+            elif entries:
+                tail, head = ZERO, entries[-1].version
+            self.log = PGLog(tail=tail, head=head, entries=entries)
             self._reindex_reqids()
+            self._log_keys = set(log_keys)
+        else:
+            # legacy monolithic blob: load it, then the first persist
+            # migrates to per-entry keys (and drops the blob)
+            got = load("log", lambda r: PGLog.dedenc(Decoder(r)),
+                       PGLog.from_dict)
+            if got is not None:
+                self.log = got
+                self._reindex_reqids()
+                self._log_dirty = True
+                self._legacy_log_key = True
         got = load("missing", lambda r: MissingSet.dedenc(Decoder(r)),
                    MissingSet.from_dict)
         if got is not None:
@@ -145,7 +190,9 @@ class PG:
         from ..common.denc import denc_bytes
         kv = {
             "info": denc_bytes(self.info),
-            "log": denc_bytes(self.log),
+            "logmeta": json.dumps(
+                [self.log.tail.to_list(),
+                 self.log.head.to_list()]).encode(),
             "missing": denc_bytes(self.missing),
             "past_intervals": denc_bytes(self.past_intervals),
             "trimmed_snaps": json.dumps(
@@ -155,11 +202,36 @@ class PG:
             kv["shard"] = str(self.shard_id).encode()
         return kv
 
+    def _persist_log(self, txn: Transaction) -> None:
+        """Per-entry log persistence, O(changed entries): new entries
+        get their own omap keys, trimmed ones are removed.  Keys are
+        (epoch, version)-unique, and a merge never re-adopts a version
+        it rewound (divergent = absent from the authoritative log), so
+        diffing against the persisted key set is exact; wholesale log
+        surgery sets _log_dirty and rewrites everything anyway."""
+        from ..common.denc import denc_bytes
+        want = {_log_key(e.version): e for e in self.log.entries}
+        have = set() if self._log_dirty else self._log_keys
+        stale = self._log_keys - set(want)
+        if self._legacy_log_key:
+            stale = stale | {"log"}
+            self._legacy_log_key = False
+        to_add = set(want) - have
+        if stale:
+            txn.omap_rmkeys(self.coll, META_OID, sorted(stale))
+        if to_add:
+            txn.omap_setkeys(self.coll, META_OID,
+                             {k: denc_bytes(want[k])
+                              for k in sorted(to_add)})
+        self._log_keys = set(want)
+        self._log_dirty = False
+
     def persist_meta(self, txn: Transaction | None = None) -> None:
         own = txn is None
         if own:
             txn = Transaction()
         txn.omap_setkeys(self.coll, META_OID, self._meta_kv())
+        self._persist_log(txn)
         if own:
             self.osd.store.queue_transaction(txn)
 
@@ -312,6 +384,7 @@ class PG:
                     or self.osd.osdmap.epoch != epoch):
                 return       # a newer interval owns peering now
             try:
+                # lint: disable=await-under-lock -- peering deliberately freezes the PG across its peer consultations: ops queue until the interval is established (the reference's peering interlock)
                 async with self.lock:
                     await self._peer_locked()
                 return
@@ -406,6 +479,7 @@ class PG:
                     await self._await_acting_change()
                     self._set_state("peering")
             divergent = self.log.merge(auth_entries, best_info, self.missing)
+            self._log_dirty = True       # wholesale surgery: rewrite
             self._clean_divergent(divergent)
             self._reindex_reqids()
             self._sync_info_from_log()
@@ -593,6 +667,7 @@ class PG:
                 self.info.backfill_complete = False
             divergent = self.log.merge(auth_entries, auth_info,
                                        self.missing)
+            self._log_dirty = True       # wholesale surgery: rewrite
             self._clean_divergent(divergent)
             self._reindex_reqids()
             self._sync_info_from_log()
@@ -650,9 +725,16 @@ class PG:
         snapid = msg.data.get("snapid")
         if top is not None:
             top.event("queued_for_pg")
+        commit: asyncio.Task | None = None
+        # lint: disable=await-under-lock -- the deliberate remainder after PR 12: the COMMIT RTT is deferred past the region (the rule's original finding, fixed); what still awaits under the lock is read gathers (overlapping those is the ROADMAP read-path follow-up) and on-demand recovery of the op's own object (per-object blocking is correctness)
         async with self.lock:
             if top is not None:
                 top.event("reached_pg")
+            # per-(PG, object) completion ordering: an op may not
+            # observe or extend an object whose earlier commit is
+            # still in flight (the pipelined spine overlaps commits
+            # ACROSS objects, never within one)
+            await self._yield_to_commits(oid)
             if self.state != "active" or not self.is_primary():
                 return ({"err": "ENOTPRIMARY", "state": self.state}, [])
             if reqid is not None and reqid in self._completed_reqids:
@@ -769,29 +851,123 @@ class PG:
                 if top is not None:
                     top.event("started")
                 try:
-                    err = await self._do_writes(oid, writes, reqid,
-                                                snapc=snapc)
+                    err, commit = await self._do_writes(oid, writes,
+                                                        reqid,
+                                                        snapc=snapc)
                 except (OSError, ConnectionError, TimeoutError,
                         asyncio.TimeoutError, RuntimeError,
                         ValueError) as e:
                     # commit fan-out failed mid-flight: answer EAGAIN so
                     # the client RETRIES (reqid dedup absorbs a partial
                     # local apply) instead of timing out reply-less
-                    err = "EAGAIN"
+                    err, commit = "EAGAIN", None
                     if top is not None:
                         top.event(f"write_failed: {e}")
                 if top is not None:
                     top.event("commit_sent")
                 if err:
                     return ({"err": err}, [])
+                if commit is not None:
+                    commit = self._chain_commit(oid, commit)
             ret = ({"results": results,
                     "version": self.info.last_update.to_list()}, segments)
+        # the PG lock is free from here: the deferred commit's peer
+        # round trip overlaps the NEXT op's gather/encode/store phases
+        # (the pipelined write spine) -- client-visible semantics are
+        # unchanged because the reply below still waits for the
+        # commits, and _chain_commit keeps per-object order
+        if commit is not None:
+            err = await self._await_commit(commit, top)
+            if err:
+                return ({"err": err}, [])
         # notify ack-waits run OUTSIDE the PG lock (see _do_watch_op)
         for r in results:
             wait = r.pop("__wait", None)
             if wait is not None:
                 await wait()
         return ret
+
+    # -- pipelined commit ordering (PR 12) -----------------------------------
+    async def _yield_to_commits(self, oid: str) -> None:
+        """Block until no deferred commit is pending for ``oid``.
+
+        Entered and exited with the PG lock HELD, but the lock is
+        RELEASED around the wait: holding it across the commit's peer
+        round trip would re-serialize the whole PG on one object --
+        exactly the await-under-lock failure mode the pipeline
+        removes.  Loops because another op may slot a new commit for
+        the same object between the wake-up and the re-acquire."""
+        while True:
+            gate = self._obj_commits.get(oid)
+            if gate is None or gate.done():
+                return
+            self.lock.release()
+            try:
+                await asyncio.wait({gate})
+            finally:
+                await self.lock.acquire()
+
+    def _chain_commit(self, oid: str, commit) -> asyncio.Task:
+        """Per-(PG, object) completion ordering: this op's commit
+        (a bare coroutine from the backend) resolves only after every
+        earlier commit on the same object, so replies reach clients
+        in version order even when the fan-outs themselves overlap.
+        Called under the PG lock; the returned task runs to
+        completion even if the op that awaits it is cancelled (the
+        laggard healing inside must not be lost)."""
+        prev = self._obj_commits.get(oid)
+
+        async def _ordered():
+            if prev is not None:
+                # the earlier op consumes its own failure; prev only
+                # ORDERS us here
+                await asyncio.wait({prev})
+            await commit
+
+        task = asyncio.ensure_future(_ordered())
+
+        def _cleanup(t: asyncio.Task) -> None:
+            if self._obj_commits.get(oid) is t:
+                del self._obj_commits[oid]
+            if not t.cancelled():
+                t.exception()    # consumed: the awaiting op reports it
+
+        task.add_done_callback(_cleanup)
+        self._obj_commits[oid] = task
+        return task
+
+    async def _await_commit(self, commit: asyncio.Task,
+                            top=None) -> str | None:
+        """Await a chained commit OUTSIDE the PG lock; the wait time
+        is exactly the round trip the pipeline overlapped with other
+        ops' prepare phases (counted as commit_overlap_ms)."""
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        try:
+            await commit
+        except (OSError, ConnectionError, TimeoutError,
+                asyncio.TimeoutError, RuntimeError, ValueError) as e:
+            if top is not None:
+                top.event(f"commit_failed: {e}")
+            return "EAGAIN"
+        finally:
+            perf = getattr(self.osd, "perf_pipeline", None)
+            if perf is not None:
+                perf.inc("overlapped_commits")
+                perf.inc("commit_overlap_ms",
+                         int((loop.time() - t0) * 1000))
+        if top is not None:
+            top.event("commit_acked")
+        return None
+
+    async def drain_commits(self) -> None:
+        """Wait for every pending deferred commit on this PG (scrub
+        and other whole-PG readers quiesce the pipeline before
+        comparing shard states).  Call WITHOUT the PG lock."""
+        pending = [t for t in self._obj_commits.values()
+                   if not t.done()]
+        if pending:
+            await asyncio.wait(pending)
 
     # -- pending-write overlay (in-order read-after-write) -------------------
     async def _make_overlay(self, oid: str) -> dict:
@@ -944,12 +1120,17 @@ class PG:
                    if w.get("addr")]
         try:
             if entries:
-                await self._do_writes(self.WATCH_REGISTRY_OID, [
-                    {"op": "omap_set",
-                     "kv": {oid: json.dumps(entries).encode()}}], None)
+                _, commit = await self._do_writes(
+                    self.WATCH_REGISTRY_OID, [
+                        {"op": "omap_set",
+                         "kv": {oid: json.dumps(entries).encode()}}],
+                    None)
             else:
-                await self._do_writes(self.WATCH_REGISTRY_OID, [
-                    {"op": "omap_rm", "keys": [oid]}], None)
+                _, commit = await self._do_writes(
+                    self.WATCH_REGISTRY_OID, [
+                        {"op": "omap_rm", "keys": [oid]}], None)
+            if commit is not None:
+                await commit     # registry writes stay synchronous
         except (ConnectionError, OSError, asyncio.TimeoutError):
             pass              # next watch/unwatch rewrites the set
 
@@ -1079,6 +1260,7 @@ class PG:
                     self.coll, SNAPMAPPER_OID) if k.startswith(prefix)]
                 for key in rows:
                     head = key[len(prefix):]
+                    # lint: disable=await-under-lock -- snap trim rewrites clones through the normal write path one object at a time; the background cadence tolerates the hold and a torn trim would corrupt the snapset
                     async with self.lock:
                         if self.state != "active" \
                                 or not self.is_primary():
@@ -1161,16 +1343,21 @@ class PG:
 
     async def _do_writes(self, oid: str, ops: list[dict],
                          reqid: tuple[str, int] | None = None,
-                         snapc: dict | None = None) -> str | None:
+                         snapc: dict | None = None) -> tuple:
         """Resolve logical ops to offset-explicit mutations, append a log
-        entry, run the backend transaction."""
+        entry, run the backend transaction.
+
+        Returns ``(err, commit)``: on the pipelined spine ``commit``
+        is the deferred remote-commit Task (local apply + sub-op sends
+        already happened; the caller awaits it OUTSIDE the PG lock),
+        None on the serial chain or pure-local writes."""
         await self.wait_for_backfill_pushes(oid)
         size = await self.backend.object_size(oid)
         snap_muts: list[dict] = []
         if snapc and snapc.get("snaps"):
             got = await self._prepare_cow(oid, snapc, size)
             if isinstance(got, str):
-                return got
+                return got, None
             snap_muts = got
         muts: list[dict] = []
         is_delete = False       # tracks the FINAL state: remove followed
@@ -1213,13 +1400,13 @@ class PG:
                 size = 0
             elif name == "setxattr":
                 if op["name"] in HIDDEN_XATTRS:
-                    return f"EINVAL reserved xattr {op['name']}"
+                    return f"EINVAL reserved xattr {op['name']}", None
                 muts.append({"op": "setxattr", "name": op["name"],
                              "value": op["value"]})
                 is_delete = False
             elif name == "rmxattr":
                 if op["name"] in HIDDEN_XATTRS:
-                    return f"EINVAL reserved xattr {op['name']}"
+                    return f"EINVAL reserved xattr {op['name']}", None
                 muts.append({"op": "rmxattr", "name": op["name"]})
             elif name == "omap_set":
                 muts.append({"op": "omap_set", "kv": op["kv"]})
@@ -1234,8 +1421,8 @@ class PG:
             version=EVersion(self.osd.osdmap.epoch,
                              self.info.last_update.version + 1),
             prior_version=prior, mutations=[], reqid=reqid)
-        await self.backend.submit_transaction(entry, muts)
-        return None
+        commit = await self.backend.submit_transaction(entry, muts)
+        return None, commit
 
     # -- recovery -----------------------------------------------------------
     def kick_recovery(self) -> None:
@@ -1263,6 +1450,7 @@ class PG:
                     break
                 await self.osd.admit(OpClass.RECOVERY)
                 try:
+                    # lint: disable=await-under-lock -- log-based recovery deliberately blocks client ops for its round (the per-object interlock); whole-PG backfill runs OUTSIDE the lock below
                     async with self.lock:
                         for oid in list(self.missing.items):
                             await self._recover_object(oid)

@@ -304,6 +304,11 @@ async def scrub_ec(pg, repair: bool = False) -> ScrubResult:
 
 
 async def scrub_pg(pg, repair: bool = False) -> ScrubResult:
+    # quiesce the pipelined write spine first: a deferred commit still
+    # in flight would make replica shard states legitimately lag the
+    # primary's, which scrub would misread as inconsistency
+    await pg.drain_commits()
+    # lint: disable=await-under-lock -- scrub deliberately freezes the PG while it compares shard states; the drain above keeps in-flight commits out of the hold
     async with pg.lock:
         if isinstance(pg.backend, ECBackend):
             return await scrub_ec(pg, repair=repair)

@@ -337,14 +337,19 @@ class MeshCodec:
         self._count(b)
         return out
 
-    def encode(self, codec, batch: np.ndarray, with_crc: bool = False):
+    def encode(self, codec, batch: np.ndarray, with_crc: bool = False,
+               out_np: bool = True):
         """(B, k, L) data chunks -> (B, m, L) parity in one sharded
         launch; ``with_crc`` adds the (B, k+m) chunk CRCs computed
         inside the SAME launch (no second round trip, no host
-        re-scan)."""
+        re-scan).  ``out_np=False`` leaves the result on device (the
+        pipelined batcher defers the materialization past its overlap
+        window)."""
         mat = codec.encode_matrix[codec.k:]
         if not with_crc:
             out = self._apply(mat, batch, False)
+            if not out_np:
+                return out
             # lint: disable=device-path-host-sync -- the single post-launch materialization
             return np.asarray(out)
         out, crcs = self._apply(mat, batch, True)
@@ -352,10 +357,13 @@ class MeshCodec:
         PERF.inc("fused_launches")
         PERF.inc("fused_crcs", int(batch.shape[0])
                  * (batch.shape[1] + out.shape[1]))
+        if not out_np:
+            return out, crcs
         # lint: disable=device-path-host-sync -- the single post-launch materialization
         return np.asarray(out), np.asarray(crcs)
 
-    def decode(self, codec, erasures, batch: np.ndarray) -> np.ndarray:
+    def decode(self, codec, erasures, batch: np.ndarray,
+               out_np: bool = True):
         """(B, k, L) survivors (decode-index order, the decode_batch
         contract) -> (B, len(erasures), L) recovered chunks."""
         erasures = tuple(int(e) for e in erasures)
@@ -367,11 +375,14 @@ class MeshCodec:
             enc = np.ascontiguousarray(codec.encode_matrix, np.uint8)
             matrix = _decode_matrix_cached(enc.tobytes(), *enc.shape,
                                            codec.k, erasures)
+        out = self._apply(matrix, batch, False)
+        if not out_np:
+            return out
         # lint: disable=device-path-host-sync -- the single post-launch materialization
-        return np.asarray(self._apply(matrix, batch, False))
+        return np.asarray(out)
 
     def rmw(self, codec, old_parity: np.ndarray,
-            delta: np.ndarray) -> np.ndarray:
+            delta: np.ndarray, out_np: bool = True):
         """Partial-stripe RMW: (B, m, L) old parity + (B, k, L) delta
         (zeros outside the written range) -> (B, m, L) new parity.
         One launch; the old-parity device buffer is donated and
@@ -389,6 +400,8 @@ class MeshCodec:
             self._count(b)
         if self.perf is not None:
             self.perf.inc("mesh_rmw_launches")
+        if not out_np:
+            return out
         # lint: disable=device-path-host-sync -- the single post-launch materialization
         return np.asarray(out)
 

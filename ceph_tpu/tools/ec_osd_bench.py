@@ -116,6 +116,14 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
             for key, v in dump.items():
                 if key.startswith("flush_"):
                     flush[key] = flush.get(key, 0) + v
+        # the write-pipeline set (PR 12): staged launches, overlap
+        # windows, stalls, deferred-commit overlap, coalesced flushes
+        pipeline: dict[str, int] = {}
+        for osd in osds:
+            for key, v in osd.perf.dump().get("ec_pipeline",
+                                              {}).items():
+                if isinstance(v, (int, float)):
+                    pipeline[key] = pipeline.get(key, 0) + v
         mesh_report = {
             "launches": mesh_launches,
             "fallbacks": mesh_fallbacks,
@@ -141,6 +149,7 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
                 "fallbacks": xor_fallbacks,
                 "terms_saved": xor_saved,
             },
+            "ec_pipeline": pipeline,
             "flush_reasons": flush,
             "n_osds": n_osds, "k": k, "m": m,
             "objects": n_objects, "obj_bytes": obj_bytes,

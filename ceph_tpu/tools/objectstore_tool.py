@@ -116,7 +116,16 @@ def op_meta(store, pgid: str) -> int:
     out = {}
     if "info" in omap:
         out["info"] = PGInfo.dedenc(Decoder(omap["info"])).to_dict()
-    if "log" in omap:
+    entry_keys = sorted(k for k in omap if k.startswith("log."))
+    if entry_keys:
+        # per-entry format (PR 12): one omap key per entry, bounds in
+        # "logmeta" (tail/head as EVersion lists)
+        head = tail = [0, 0]
+        if "logmeta" in omap:
+            tail, head = json.loads(omap["logmeta"])
+        out["log"] = {"head": list(head), "tail": list(tail),
+                      "entries": len(entry_keys)}
+    elif "log" in omap:
         log = PGLog.dedenc(Decoder(omap["log"]))
         out["log"] = {"head": log.head.to_list(),
                       "tail": log.tail.to_list(),

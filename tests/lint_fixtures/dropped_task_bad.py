@@ -16,3 +16,15 @@ async def hedge(osd):
     # the (tid, task) tuple is dropped: the sub-read task is orphaned,
     # never cancelled/reaped, its late reply never drained
     osd.start_request(3, "ec_subop_read", {"oid": "o", "shard": 1})
+
+
+async def commit(backend):
+    # the staged reply waiters are dropped: the sub-op sends go out
+    # but nobody ever drains the commit acks (wedged waiters)
+    backend.osd.fanout_staged([(1, "ec_subop_write", {}, [])])
+
+
+async def flush(pipe):
+    # the flush-window coroutine is dropped: the staged flush never
+    # ships and every staged sub-op's op wedges
+    pipe.arm_flush_window()

@@ -37,3 +37,23 @@ class Daemon:
             return await task
         finally:
             task.cancel()
+
+
+class Pipe:
+    def __init__(self):
+        self._flush_task = None
+
+    async def commit(self, backend, entry):
+        # the waiters are owned: staged, then awaited for the acks
+        futs = backend.osd.fanout_staged(
+            [(1, "ec_subop_write", {}, [])])
+        return await backend.osd.await_staged(futs, collect=True)
+
+    def stage_one(self):
+        # the flush-window task is kept on an attribute (the pipe
+        # owns its lifetime and cancels it at close)
+        self._flush_task = asyncio.ensure_future(
+            self.arm_flush_window())
+
+    async def arm_flush_window(self):
+        pass

@@ -1,0 +1,27 @@
+"""await-under-lock bad fixture: PG lock held across a peer RTT.
+
+The region awaits a local helper whose call chain reaches the OSD
+fan-out API -- the holder suspends for a full peer round trip and
+every op queued on the lock inherits it.
+"""
+import asyncio
+
+
+class OSD:
+    async def fanout_and_wait(self, requests, timeout=10.0):
+        await asyncio.sleep(0)      # stands in for the peer RTT
+        return []
+
+
+class PG:
+    def __init__(self, osd):
+        self.osd = osd
+        self.lock = asyncio.Lock()
+
+    async def _commit(self, targets):
+        return await self.osd.fanout_and_wait(targets)
+
+    async def do_op(self, targets):
+        async with self.lock:
+            await self._commit(targets)
+        return True

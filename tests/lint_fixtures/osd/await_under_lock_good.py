@@ -1,0 +1,35 @@
+"""await-under-lock good fixture: the RTT waits outside the lock.
+
+The prepare phase runs under the lock (local state only); the commit
+fan-out is spawned as its own task inside the region (the lock is NOT
+held across a spawned task's awaits) and awaited after release.
+"""
+import asyncio
+
+
+class OSD:
+    async def fanout_and_wait(self, requests, timeout=10.0):
+        await asyncio.sleep(0)      # stands in for the peer RTT
+        return []
+
+
+class PG:
+    def __init__(self, osd):
+        self.osd = osd
+        self.lock = asyncio.Lock()
+        self.version = 0
+
+    async def _prepare(self):
+        # local-only await: no peer round trip reachable
+        await asyncio.sleep(0)
+        self.version += 1
+
+    async def _commit(self, targets):
+        return await self.osd.fanout_and_wait(targets)
+
+    async def do_op(self, targets):
+        async with self.lock:
+            await self._prepare()
+            commit = asyncio.ensure_future(self._commit(targets))
+        await commit
+        return True

@@ -231,6 +231,14 @@ def test_cluster_smoke_exits_zero_with_no_failed_ops():
     assert res["interference"]["revived"]
     assert res["qos"]["steady"]["dispatched_client"] > 0
     assert res["p99_degradation"]["degraded"]
+    # the pipelined write spine's overlap counters are LIVE (PR 12):
+    # batches staged ahead of the in-flight launch, commits awaited
+    # outside the PG lock, sub-op flush windows shipped
+    pipe = res["counters"]["ec_pipeline"]
+    assert pipe["staged_batches"] > 0
+    assert pipe["overlapped_commits"] > 0
+    assert pipe["commit_overlap_ms"] > 0
+    assert pipe["flush_windows"] > 0
 
 
 def test_straggler_smoke_gates_hold():

@@ -55,20 +55,31 @@ async def _ec_cluster(n=3, k="2", m="1"):
 
 
 def _spy_subop_bytes(c, pgid):
-    """Wrap the primary's fanout to count ec_subop_write segment bytes."""
+    """Wrap the primary's fan-outs to count ec_subop_write segment
+    bytes -- both the serial chain (fanout_and_wait) and the
+    pipelined staged path (fanout_staged)."""
     primary_osd = next(o for o in c.osds
                        if pgid in o.pgs and o.pgs[pgid].is_primary())
     counts = {"bytes": 0, "calls": 0}
     orig = primary_osd.fanout_and_wait
+    orig_staged = primary_osd.fanout_staged
 
-    async def spy(targets, **kw):
+    def _count(targets):
         for t in targets:
             if t[1] == "ec_subop_write":
                 counts["calls"] += 1
                 counts["bytes"] += sum(len(s) for s in t[3])
+
+    async def spy(targets, **kw):
+        _count(targets)
         return await orig(targets, **kw)
 
+    def spy_staged(targets, **kw):
+        _count(targets)
+        return orig_staged(targets, **kw)
+
     primary_osd.fanout_and_wait = spy
+    primary_osd.fanout_staged = spy_staged
     return counts
 
 

@@ -24,6 +24,8 @@ TREE_PATHS = ["ceph_tpu", "tools", "bench.py"]
 BASELINE = os.path.join(REPO, "tools", "lint_baseline.txt")
 
 RULE_FIXTURES = {
+    "await-under-lock": ("osd/await_under_lock_bad.py",
+                         "osd/await_under_lock_good.py"),
     "config-schema": ("config_schema_bad.py",
                       "config_schema_good.py"),
     "dropped-task": ("dropped_task_bad.py",

@@ -887,6 +887,82 @@ def _datapath_mode(deadline: float, smoke: bool) -> int:
     return rc
 
 
+def _recovery_mode(deadline: float, smoke: bool) -> int:
+    """--recovery: repair I/O under RS vs LRC vs PMSR
+    (ceph_tpu/tools/recovery_bench.py).
+
+    The same kill -> degraded-write -> revive -> recover drive on
+    identical seeds, one cluster per code family, reporting repair
+    GiB read/shipped (the new ``ec_recovery`` counters) and recovery
+    wall clock.  Gates: zero failed/wedged ops and byte-identical
+    read-back through every drive (verified against a survivor kill),
+    LRC single-failure repair reads <= 0.5x the RS bytes at the
+    k=8-class config, and PMSR helper traffic strictly under k full
+    chunks (fragment pulls counted, not assumed)."""
+    import asyncio
+    from ceph_tpu.tools.recovery_bench import run_recovery_bench
+
+    if smoke:
+        kwargs = dict(n_objects=4, obj_size=32 << 10, pg_num=8)
+    else:
+        kwargs = dict(
+            n_objects=int(os.environ.get("BENCH_REC_OBJECTS", "16")),
+            obj_size=int(os.environ.get("BENCH_REC_OBJ_KIB",
+                                        "128")) << 10,
+            pg_num=int(os.environ.get("BENCH_REC_PGS", "16")))
+    log(f"recovery mode: {kwargs} smoke={smoke}")
+    res = asyncio.new_event_loop().run_until_complete(
+        run_recovery_bench(**kwargs, smoke=smoke, log=log))
+    codes = res["codes"]
+    log(f"recovery: read/shipped rs={codes['rs']['read_per_shipped']}"
+        f"x lrc={codes['lrc']['read_per_shipped']}x "
+        f"pmsr={codes['pmsr']['read_per_shipped']}x "
+        f"(lrc vs rs {res['lrc_vs_rs_read_ratio']}x)")
+    RESULT.update({
+        "metric": "recovery_repair_read_ratio_lrc_vs_rs",
+        "value": res["lrc_vs_rs_read_ratio"],
+        "unit": "x",
+        "vs_baseline": res["lrc_vs_rs_read_ratio"],
+        "baseline_note": "identical kill/recover drive on an RS "
+                         "(plugin=tpu) pool of the same k,m: repair "
+                         "reads k full chunks per rebuilt shard",
+        "smoke": smoke,
+        **{key: res[key] for key in
+           ("spec", "codes", "lrc_vs_rs_read_ratio",
+            "pmsr_read_chunks", "failed_objects", "errors")},
+    })
+    emit()
+    rc = 0
+    if res["failed_objects"] or res["errors"]:
+        log(f"ERROR: {res['failed_objects']} corrupt/wedged objects, "
+            f"{res['errors']} drive errors")
+        rc = 1
+    for name, c in codes.items():
+        if not c["recovered_clean"]:
+            log(f"ERROR: {name} recovery never converged")
+            rc = 1
+        if not c["repair_bytes_shipped"]:
+            log(f"ERROR: {name} recovery shipped no counted bytes")
+            rc = 1
+    if res["lrc_vs_rs_read_ratio"] > 0.5 \
+            or not res["lrc_vs_rs_read_ratio"]:
+        log(f"ERROR: lrc repair reads "
+            f"{res['lrc_vs_rs_read_ratio']}x of RS (gate: <= 0.5x)")
+        rc = 1
+    if not (0 < res["pmsr_read_chunks"] < codes["pmsr"]["k"]):
+        log(f"ERROR: pmsr helper traffic "
+            f"{res['pmsr_read_chunks']} chunks not under k="
+            f"{codes['pmsr']['k']}")
+        rc = 1
+    if not codes["pmsr"]["repair_fragment_pulls"]:
+        log("ERROR: pmsr recovery never took the fragment path")
+        rc = 1
+    if not codes["lrc"]["repair_local_repairs"]:
+        log("ERROR: lrc recovery never repaired locally")
+        rc = 1
+    return rc
+
+
 def _straggler_mode(deadline: float, smoke: bool) -> int:
     """--straggler: hedged vs unhedged EC reads under deterministic
     heavy-tail delays (ceph_tpu/tools/straggler_bench.py).
@@ -1411,6 +1487,9 @@ def main() -> int:
     if "--straggler" in sys.argv[1:] or os.environ.get("BENCH_STRAGGLER"):
         _ALLOW_STALE = False
         return _straggler_mode(deadline, "--smoke" in sys.argv[1:])
+    if "--recovery" in sys.argv[1:] or os.environ.get("BENCH_RECOVERY"):
+        _ALLOW_STALE = False
+        return _recovery_mode(deadline, "--smoke" in sys.argv[1:])
     if "--placement" in sys.argv[1:] or os.environ.get("BENCH_PLACEMENT"):
         _ALLOW_STALE = False
         return _placement_mode(deadline, "--smoke" in sys.argv[1:])

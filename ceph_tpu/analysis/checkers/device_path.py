@@ -80,6 +80,15 @@ ROOTS = (
     "CodecBatcher._drive",
     "CodecBatcher._dispatch",
     "CodecBatcher._complete",
+    # the flat linear codec family (ec/linear_codec.py): lrc/pmsr
+    # encode/decode ride the batched scheduled/dense kernels through
+    # these, and the mesh flat-dialect RMW reshape wraps the same
+    # launches -- a host hop inside any of them re-serializes every
+    # layered/regenerating launch
+    "LinearSubchunkCodec.encode_batch",
+    "LinearSubchunkCodec.decode_batch",
+    "LinearSubchunkCodec._batch_matmul",
+    "MeshCodec._rmw_flat",
 )
 
 # ambiguity budget: a fuzzy call edge that could hit more than this

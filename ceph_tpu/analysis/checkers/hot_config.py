@@ -49,6 +49,14 @@ ROOTS = (
     "ECBackend._fetch_shards",
     "ECBackend._gather_shards",
     "ECBackend.collect_shard_states",
+    # the recovery repair path (runs per rebuilt shard: fragment
+    # pulls + full gathers) and the flat codec launch entry points --
+    # the osd_ec_repair_fragments_enabled gate is snapshot at
+    # construction, never read per repair
+    "ECBackend.read_recovery_payload",
+    "ECBackend._fragment_recover",
+    "LinearSubchunkCodec.encode_batch",
+    "LinearSubchunkCodec.decode_batch",
     "HedgedGather.gather_shards",
     "HedgedGather.first_reply",
     "DeviceShardCache.get",

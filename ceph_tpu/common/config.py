@@ -168,6 +168,12 @@ DEFAULT_SCHEMA: list[Option] = [
     Option("osd_datapath_cache_entry_max", OPT_INT, 8 << 20,
            "largest single shard buffer the cache will hold (bigger "
            "shards always read through the store)", min=0),
+    Option("osd_ec_repair_fragments_enabled", OPT_BOOL, True,
+           "regenerating-code repair fragments: rebuild a lost shard "
+           "from d beta-sized computed sub-chunks (one per helper) "
+           "instead of k full chunks when the pool's codec supports "
+           "it (the pmsr plugin); any fragment failure falls back to "
+           "the full shard gather"),
     Option("osd_ec_rmw_delta_enabled", OPT_BOOL, True,
            "partial-stripe writes delta-update parity in place "
            "(parity' = parity XOR encode(delta)) instead of "

@@ -17,6 +17,16 @@ l shards, not k -- which is the whole point of the code: repair reads
 stay inside a failure domain (here: inside a mesh sub-axis, see
 ceph_tpu/parallel/sharded_ec.py lrc_local_repair).
 
+Execution rides the flat linear spine (ec/linear_codec.py): the layer
+stack composes into ONE systematic generator over the data chunks, so
+local repair and global decode are the same ``gf_solve_rows`` repair-
+matrix build over different source sets (byte-identical outputs by
+construction), encode/decode coalesce through the CodecBatcher's
+padding buckets onto the scheduled/dense GF(2) kernel family, and the
+local-parity rows -- all-ones XOR combinations, the sparsest matrices
+the greedy-CSE compiler sees -- have their schedules warmed at build
+time.
+
 Arbitrary layerings are accepted via ``mapping`` + ``layers`` profile
 keys (layers as JSON ``[[mapping, profile], ...]``), mirroring
 ErasureCodeLrc::layers_parse.
@@ -25,13 +35,12 @@ ErasureCodeLrc::layers_parse.
 from __future__ import annotations
 
 import json
-from typing import Mapping
 
 import numpy as np
 
-from ...gf import build_decode_matrix, gf_matmul
+from ...gf.gf8 import GF_MUL_TABLE
 from ...gf.matrices import gen_rs_matrix, gen_cauchy1_matrix
-from ..base import ErasureCode
+from ..linear_codec import LinearSubchunkCodec
 from ..registry import ErasureCodePlugin
 
 DEFAULT_KML = -1
@@ -53,37 +62,12 @@ class _Layer:
                else gen_rs_matrix)
         self.matrix = gen(self.k + self.m, self.k)
 
-    def encode_into(self, chunks: dict[int, np.ndarray]) -> None:
-        data = np.stack([chunks[p] for p in self.data_pos])
-        parity = gf_matmul(self.matrix[self.k:], data)
-        for r, p in enumerate(self.coding_pos):
-            chunks[p][:] = parity[r]
 
-    def recover(self, chunks: dict[int, np.ndarray],
-                missing: set[int]) -> list[int]:
-        """Decode this layer's missing chunks in place; returns the
-        positions recovered."""
-        mine = set(self.positions)
-        lost = sorted((missing & mine))
-        # local erasure indices within the layer's position ordering
-        pos_index = {p: i for i, p in enumerate(self.positions)}
-        erasures = [pos_index[p] for p in lost]
-        matrix, decode_index = build_decode_matrix(
-            self.matrix, self.k, erasures)
-        sources = np.stack([chunks[self.positions[i]]
-                            for i in decode_index])
-        recovered = gf_matmul(matrix, sources)
-        for r, p in enumerate(lost):
-            chunks[p] = recovered[r].copy()
-        return lost
-
-
-class ErasureCodeLrc(ErasureCode):
+class ErasureCodeLrc(LinearSubchunkCodec):
     def __init__(self) -> None:
         super().__init__()
-        self.k = 0
-        self.m = 0
         self.l = 0
+        self.m_global = 0          # the profile's m (global parities)
         self.mapping = ""
         self.layers: list[_Layer] = []
         self.chunk_count_ = 0
@@ -97,19 +81,32 @@ class ErasureCodeLrc(ErasureCode):
         if not any(present):
             return
         if not all(present):
-            raise ValueError("all of k, m, l must be set or none")
+            raise ValueError(
+                "lrc: all of k, m, l must be set or none (EINVAL)")
         for key in ("mapping", "layers"):
             if profile.get(key):
                 raise ValueError(
-                    f"{key} cannot be set when k/m/l are set")
-        if l == 0 or (k + m) % l:
-            raise ValueError(f"k+m={k + m} must be a multiple of l={l}")
+                    f"lrc: {key} cannot be set when k/m/l are set "
+                    f"(EINVAL)")
+        self.sanity_check_k_m(k, m)
+        if l < 1:
+            raise ValueError(
+                f"lrc: l={l} must be >= 1: each local group needs at "
+                f"least one chunk beside its local parity (EINVAL)")
+        if (k + m) % l:
+            raise ValueError(
+                f"lrc: k+m={k + m} must be a multiple of l={l} "
+                f"(EINVAL)")
         lgc = (k + m) // l
         if k % lgc:
-            raise ValueError(f"k={k} must be a multiple of (k+m)/l={lgc}")
+            raise ValueError(
+                f"lrc: k={k} must be a multiple of (k+m)/l={lgc} "
+                f"(EINVAL)")
         if m % lgc:
-            raise ValueError(f"m={m} must be a multiple of (k+m)/l={lgc}")
-        self.k, self.m, self.l = k, m, l
+            raise ValueError(
+                f"lrc: m={m} must be a multiple of (k+m)/l={lgc} "
+                f"(EINVAL)")
+        self.k, self.m_global, self.l = k, m, l
         kg, mg = k // lgc, m // lgc
         # mapping: per group D*kg + _*mg (global parities) + _ (local)
         profile["mapping"] = ("D" * kg + "_" * mg + "_") * lgc
@@ -164,11 +161,45 @@ class ErasureCodeLrc(ErasureCode):
             raise ValueError(
                 f"lrc: positions {sorted(uncovered)} are neither data "
                 f"nor computed by any layer")
+        self.m = self.chunk_count_ - self.k
+
+    def _build_generator(self) -> None:
+        """Compose the layer stack into the flat systematic generator:
+        each coding position's row over the data chunks, by GF(2^8)
+        linearity of the layers (layer order matters: a layer may read
+        positions an earlier layer computed, e.g. local parities over
+        global parities in the canonical k/m/l layout)."""
+        n, k = self.chunk_count_, self.k
+        gen = np.zeros((n, k), dtype=np.uint8)
+        defined = [False] * n
+        for i in range(k):
+            p = self.chunk_index(i)
+            gen[p, i] = 1
+            defined[p] = True
+        for layer in self.layers:
+            for dp in layer.data_pos:
+                if not defined[dp]:
+                    raise ValueError(
+                        f"lrc: layer {layer.mapping!r} reads position "
+                        f"{dp} before any layer computes it (reorder "
+                        f"the layers)")
+            for r, p in enumerate(layer.coding_pos):
+                row = np.zeros(k, dtype=np.uint8)
+                for j, dp in enumerate(layer.data_pos):
+                    c = int(layer.matrix[layer.k + r, j])
+                    if c:
+                        row ^= GF_MUL_TABLE[c][gen[dp]]
+                gen[p] = row
+                defined[p] = True
+        self.generator = gen
 
     def init(self, profile) -> None:
         self._parse_kml(profile)
         self._parse_layers(profile)
         self.parse(profile)        # builds chunk_mapping from mapping
+        self.alpha = 1
+        self._build_generator()
+        self.finish_setup()
         super().init(profile)
 
     # -- interface ----------------------------------------------------------
@@ -177,10 +208,6 @@ class ErasureCodeLrc(ErasureCode):
 
     def get_data_chunk_count(self) -> int:
         return self.k
-
-    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
-        for layer in self.layers:
-            layer.encode_into(chunks)
 
     # -- locality-aware minimum_to_decode -----------------------------------
     def _repair_plan(self, want_to_read: set[int],
@@ -251,22 +278,15 @@ class ErasureCodeLrc(ErasureCode):
         reads, _ = self._repair_plan(want_to_read, available_chunks)
         return reads
 
-    def decode_chunks(self, want_to_read: set[int],
-                      chunks: Mapping[int, np.ndarray],
-                      decoded: dict[int, np.ndarray]) -> None:
-        available = set(chunks)
-        _, order = self._repair_plan(set(want_to_read), available)
-        work = {p: np.array(v, dtype=np.uint8)
-                for p, v in decoded.items() if p in available}
-        recovered_all = set(available)
-        for li in order:
-            layer = self.layers[li]
-            got = layer.recover(work, set(range(self.chunk_count_))
-                                - recovered_all)
-            recovered_all |= set(got)
-        for p in want_to_read:
-            if p not in available:
-                decoded[p][:] = work[p]
+    def _decode_sources(self, lost: tuple[int, ...],
+                        available: set[int]) -> tuple[int, ...]:
+        """The layered plan's read set: the local group for a single
+        loss, the global closure otherwise.  The flat repair matrix
+        over these sources reproduces the layer-by-layer recovery
+        byte-for-byte (both compute the unique combination of the
+        sources that equals the lost rows)."""
+        reads, _ = self._repair_plan(set(lost), set(available))
+        return tuple(sorted(reads))
 
     def get_alignment(self) -> int:
         return 32

@@ -19,6 +19,7 @@ from .gf8 import (  # noqa: F401
     gf_mul_bytes,
     gf_matmul,
     gf_invert_matrix,
+    gf_solve_rows,
     gf_mul_bitmatrix,
     coeff_to_bitmatrix,
     matrix_to_bitmatrix,

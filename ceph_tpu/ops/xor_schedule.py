@@ -72,6 +72,16 @@ CPU_DENSE_DISCOUNT = 0.35
 # (nothing on the codec path is remotely this large)
 MAX_COMPILE_CELLS = 1 << 18
 
+# the SPECULATIVE compile bound: the backend heuristic and the
+# build-time warms compile on the chance the schedule wins, and the
+# greedy-CSE pass is quadratic in pair count -- a dense 20k-cell
+# matrix (the pmsr k=5 parity bitmatrix) costs ~15s of pure Python,
+# which would stall codec init / the first launch's event loop.
+# Above this bound only an EXPLICIT opt-in compiles: a measured
+# gf2_tuned.json entry or CEPH_TPU_XOR_SCHED=1 (both accept the
+# one-time cost knowingly).
+SPECULATIVE_MAX_CELLS = 1 << 14
+
 # below this many bytes per plane row the naive xor_matmul's C-level
 # gather+reduce beats the schedule's one-numpy-call-per-XOR dispatch
 # overhead (measured crossover ~10 KiB; CEPH_TPU_XOR_SCHED=1 forces
@@ -422,6 +432,26 @@ def warm_schedule(matrix: np.ndarray) -> XorSchedule | None:
     return sched if sched.n_terms < sched.naive_terms else None
 
 
+def warm_gf8_schedule(matrix: np.ndarray) -> XorSchedule | None:
+    """``warm_schedule`` for a GF(2^8) coefficient matrix: expand to
+    the GF(2) bit-matrix the batched kernel family keys on
+    (``gf2kernels.bitmatrix_i8``) and compile-and-cache its schedule.
+    Called when a codec builds a repair/local-parity matrix, so the
+    first batched launch with it finds the schedule cached and the
+    read/recovery path never pays the CSE compile.  Matrices above
+    the speculative bound are skipped -- codec init (which the
+    monitor runs per profile validation) must never stall on a
+    multi-second CSE pass for a matrix the cost model would not pick
+    speculatively anyway."""
+    if _env_off():
+        return None
+    from .gf2kernels import bitmatrix_i8
+    bm = bitmatrix_i8(np.ascontiguousarray(matrix, np.uint8))
+    if bm.size > SPECULATIVE_MAX_CELLS:
+        return None
+    return warm_schedule(bm)
+
+
 def apply_bits_traced(sched: XorSchedule, data_u8):
     """(k, N) bytes -> (n_out//8, N) bytes under trace: unpack to bit
     planes, run the schedule, pack.  The jax-traceable core shared by
@@ -572,6 +602,8 @@ def want_scheduled(bitmatrix: np.ndarray, lane: int, backend: str,
         return None
     if backend != "cpu" or have_packed:
         return None
+    if bitmatrix.size > SPECULATIVE_MAX_CELLS:
+        return None            # dense family serves; tune to opt in
     sched = schedule_for(bitmatrix)
     dense_macs = bitmatrix.shape[0] * bitmatrix.shape[1]
     if sched.n_terms <= CPU_DENSE_DISCOUNT * dense_macs:

@@ -440,6 +440,13 @@ class ECBackend(PGBackend):
         perf = getattr(self.osd, "perf", None)
         self.perf_degraded = perf.create("ec_degraded") \
             if perf is not None else None
+        # repair-I/O observability (perf counter set "ec_recovery"):
+        # the bytes recovery actually gathers vs ships is the whole
+        # point of the recovery-bandwidth-optimal codes -- chaos and
+        # bench.py --recovery pin the per-code ratios on these instead
+        # of trusting the repair-math claim
+        self.perf_recovery = perf.create("ec_recovery") \
+            if perf is not None else None
         # hot-path config SNAPSHOT (the ROADMAP config-reads-on-hot-
         # paths item): _gather_shards runs per degraded read; looking
         # these up per call put a dict probe chain on the read path
@@ -460,10 +467,22 @@ class ECBackend(PGBackend):
         # test backends -- every hedged path degrades to the legacy
         # fixed fanout.
         self.hedger = getattr(self.osd, "hedger", None)
+        # regenerating-code repair fragments (the pmsr plugin): helpers
+        # ship beta-sized COMPUTED sub-chunks instead of full chunks;
+        # snapshot the gate and the stripe geometry the fragment
+        # algebra reshapes at (hot-path-config-read discipline)
+        self._frag_repair = self._cfg("osd_ec_repair_fragments_enabled",
+                                      True)
+        if hasattr(self.codec, "set_fragment_chunk_size"):
+            self.codec.set_fragment_chunk_size(self.sinfo.chunk_size)
 
     def _count(self, key: str, by: int = 1) -> None:
         if self.perf_degraded is not None:
             self.perf_degraded.inc(key, by)
+
+    def _rcount(self, key: str, by: int = 1) -> None:
+        if self.perf_recovery is not None:
+            self.perf_recovery.inc(key, by)
 
     @property
     def batcher(self):
@@ -807,7 +826,8 @@ class ECBackend(PGBackend):
 
     async def _gather_shards(self, oid: str,
                              need_shards: set[int] | None = None,
-                             rng: tuple[int, int] | None = None
+                             rng: tuple[int, int] | None = None,
+                             exclude: set[int] | None = None
                              ) -> tuple[dict[int, np.ndarray], int]:
         """Read enough CONSISTENT shard buffers to decode.
 
@@ -822,7 +842,8 @@ class ECBackend(PGBackend):
         acting = self.pg.acting
         avail: dict[int, int] = {}           # shard -> osd
         for shard, osd in enumerate(acting):
-            if osd >= 0 and self.osd.osd_is_up(osd):
+            if osd >= 0 and self.osd.osd_is_up(osd) \
+                    and (exclude is None or shard not in exclude):
                 avail[shard] = osd
         want = set(need_shards
                    or self.sinfo.data_positions(self.codec))
@@ -1462,22 +1483,52 @@ class ECBackend(PGBackend):
         return size
 
     async def read_recovery_payload(self, oid, shard) -> dict:
-        """Reconstruct the target shard's buffer for a recovering peer."""
-        bufs, size, ver = await self._gather_shards(oid, need_shards={shard})
-        if ver == (0, 0) and not any(len(b) for b in bufs.values()):
-            # object exists on no shard: tell the peer to remove its
-            # copy (backfill pushes extras as absent)
-            return {"data": b"", "xattrs": {}, "omap": {}, "absent": True}
-        if shard in bufs:
-            buf = bufs[shard]
+        """Reconstruct the target shard's buffer for a recovering peer.
+
+        Regenerating codecs (pmsr) take the FRAGMENT path first: d
+        helpers each ship one beta-sized computed sub-chunk instead of
+        a full chunk, so rebuilding one shard moves d/alpha chunks of
+        bytes instead of k (counted in ``ec_recovery``, asserted by
+        chaos/bench, never assumed).  Any fragment-path failure --
+        helper down, version skew, codec ineligible -- falls back to
+        the full shard gather transparently."""
+        self._rcount("repair_reads")
+        frag = await self._fragment_recover(oid, shard)
+        if frag is not None:
+            buf, size, ver = frag
         else:
-            # reconstruction decode rides the batcher: concurrent
-            # recovery/backfill pushes for the same down-shard pattern
-            # share one decode_batch launch
-            self._count("reconstructions")
-            decoded = await self.sinfo.decode_async(
-                self.codec, bufs, want={shard}, batcher=self.batcher)
-            buf = decoded[shard]
+            # the target shard is being REBUILT: its holder's current
+            # (empty or stale) bytes must never serve as the source of
+            # itself -- a revived OSD answering the gather for its own
+            # missing shard used to satisfy the plan with an absent
+            # reply, and the "recovery" pushed a remove instead of a
+            # reconstruction (the shard stayed lost forever)
+            bufs, size, ver = await self._gather_shards(
+                oid, need_shards={shard}, exclude={int(shard)})
+            self._rcount("repair_bytes_read",
+                         sum(len(b) for b in bufs.values()))
+            if len(bufs) < self.sinfo.k:
+                # a layered plan (the LRC local group) read fewer than
+                # k chunks: the locality savings, counted
+                self._rcount("repair_local_repairs")
+            else:
+                self._rcount("repair_global_decodes")
+            if ver == (0, 0) and not any(len(b) for b in bufs.values()):
+                # object exists on no shard: tell the peer to remove
+                # its copy (backfill pushes extras as absent)
+                return {"data": b"", "xattrs": {}, "omap": {},
+                        "absent": True}
+            if shard in bufs:
+                buf = bufs[shard]
+            else:
+                # reconstruction decode rides the batcher: concurrent
+                # recovery/backfill pushes for the same down-shard
+                # pattern share one decode_batch launch
+                self._count("reconstructions")
+                decoded = await self.sinfo.decode_async(
+                    self.codec, bufs, want={shard},
+                    batcher=self.batcher)
+                buf = decoded[shard]
         # the pushed shard must carry the version stamp (an unstamped
         # recovered shard would read as (0,0) and be rejected as stale
         # by _gather_shards forever after) AND its identity pin: the
@@ -1485,6 +1536,7 @@ class ECBackend(PGBackend):
         # self-describing, and again at the payload top level so the
         # receiver can verify BEFORE applying anything
         raw = buf.tobytes()
+        self._rcount("repair_bytes_shipped", len(raw))
         return {"data": raw,
                 "xattrs": {SIZE_XATTR: str(size).encode(),
                            VER_XATTR: f"{ver[0]},{ver[1]}".encode(),
@@ -1492,3 +1544,111 @@ class ECBackend(PGBackend):
                            CRC_XATTR: str(shard_crc(raw)).encode()},
                 "omap": {},
                 "shard": int(shard)}
+
+    # -- regenerating-code repair fragments (pmsr) ---------------------------
+    def fragment_of(self, oid: str, lost_shard: int
+                    ) -> tuple[bytes, int, tuple, int | None] | None:
+        """This OSD's beta-sized repair fragment for ``lost_shard``:
+        the locally stored chunk combined by the codec's fragment row.
+        Returns (fragment bytes, size, ver, my shard label), or None
+        when the codec has no fragment algebra or nothing is stored."""
+        if not hasattr(self.codec, "fragment_for"):
+            return None
+        buf, size, ver, label, _, _ = self._local_entry(oid)
+        if not len(buf):
+            return None
+        frag = self.codec.fragment_for(lost_shard, buf)
+        return frag.tobytes(), size, tuple(ver), label
+
+    async def _fragment_recover(self, oid: str, shard: int
+                                ) -> tuple | None:
+        """Rebuild ``shard`` from beta-sized helper fragments, or None
+        (fall back to the full-chunk gather).  Every fragment reply is
+        identity-checked -- the helper's write-time shard label must
+        match its serving position and all versions must agree -- so a
+        remapped or stale helper degrades to the safe path instead of
+        aggregating garbage."""
+        codec = self.codec
+        if not self._frag_repair \
+                or not hasattr(codec, "minimum_to_repair"):
+            return None
+        acting = self.pg.acting
+        avail = {s: osd for s, osd in enumerate(acting)
+                 if osd >= 0 and self.osd.osd_is_up(osd)}
+        plan = codec.minimum_to_repair(int(shard),
+                                       set(avail) - {int(shard)})
+        if not plan:
+            return None
+        sub = codec.get_sub_chunk_count()
+        if all(sum(c for _, c in spec) >= sub
+               for spec in plan.values()):
+            return None           # no fragment saving: gather instead
+        frags: dict[int, np.ndarray] = {}
+        meta: dict[int, tuple] = {}
+        remote = []
+        for h in plan:
+            if h not in avail:
+                return None
+            if avail[h] == self.osd.whoami:
+                local = self.fragment_of(oid, int(shard))
+                if local is None:
+                    return None
+                fbuf, size, ver, label = local
+                if not self._label_ok(h, label, fbuf, ver):
+                    return None
+                frags[h] = np.frombuffer(fbuf, np.uint8)
+                meta[h] = (size, ver)
+            else:
+                remote.append(h)
+        if remote:
+            payload = {"pgid": self.pg.pgid, "oid": oid,
+                       "frag_for": int(shard)}
+            try:
+                replies = await self.osd.fanout_and_wait(
+                    [(avail[h], "ec_subop_read",
+                      {**payload, "shard": h}, []) for h in remote],
+                    collect=True, timeout=self._read_timeout)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                self._rcount("repair_fragment_falls")
+                return None
+            for rep in replies:
+                h = rep.data.get("req_shard")
+                if h is None or h not in remote \
+                        or rep.data.get("frag_err"):
+                    continue
+                fbuf = rep.segments[0] if rep.segments else b""
+                crc = rep.data.get("crc")
+                if crc is not None and not shard_crc_matches(fbuf, crc):
+                    self._count("crc_mismatch")
+                    continue
+                label = rep.data.get("shard")
+                ver = tuple(rep.data.get("ver", (0, 0)))
+                if not self._label_ok(h, label,
+                                      np.frombuffer(fbuf, np.uint8),
+                                      ver):
+                    self._count("shard_mismatch")
+                    continue
+                frags[h] = np.frombuffer(fbuf, np.uint8)
+                meta[h] = (rep.data.get("size", 0), ver)
+        if set(frags) != set(plan):
+            self._rcount("repair_fragment_falls")
+            return None
+        vers = {v for _, v in meta.values()}
+        lens = {len(f) for f in frags.values()}
+        if len(vers) != 1 or len(lens) != 1 or not lens.pop():
+            # version skew mid-recovery or ragged fragments: the
+            # aggregate would mix stripes from different writes
+            self._rcount("repair_fragment_falls")
+            return None
+        try:
+            buf = codec.aggregate_fragments(int(shard), frags)
+        except (IOError, OSError, ValueError):
+            self._rcount("repair_fragment_falls")
+            return None
+        nbytes = sum(len(f) for f in frags.values())
+        self._rcount("repair_fragment_pulls")
+        self._rcount("repair_fragments", len(frags))
+        self._rcount("repair_bytes_read", nbytes)
+        size = max(s for s, _ in meta.values())
+        return buf, size, vers.pop()          # uint8 ndarray from the
+                                              # aggregate, shard-sized

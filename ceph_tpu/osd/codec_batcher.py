@@ -210,11 +210,17 @@ class CodecBatcher:
     def supports(codec) -> bool:
         """Batched entry points exist and the chunk layout is the plain
         positional one (a chunk remapping would decouple shard ids from
-        matrix rows, which the batch kernels do not model)."""
+        matrix rows, which the batch kernels do not model) -- unless
+        the codec declares ``batch_chunk_mapping_ok``: the flat linear
+        family (ec/linear_codec.py) keys its generator by position and
+        the StripeInfo drivers place its chunks via ``chunk_index``, so
+        mapped layouts (lrc) coalesce safely."""
         return (hasattr(codec, "encode_batch")
                 and hasattr(codec, "decode_batch")
                 and getattr(codec, "encode_matrix", None) is not None
-                and not codec.get_chunk_mapping())
+                and (not codec.get_chunk_mapping()
+                     or getattr(codec, "batch_chunk_mapping_ok",
+                                False)))
 
     # -- submission ---------------------------------------------------------
     async def encode(self, codec, stripes: np.ndarray,
@@ -513,6 +519,7 @@ class CodecBatcher:
                     out = mesh.rmw(grp.codec, old_batch, batch,
                                    out_np=False)
                 elif grp.kind == "encode" and want_crc \
+                        and hasattr(grp.codec, "encode_batch_crc") \
                         and self._fused_crc_ok():
                     out, crcs = mesh.encode(grp.codec, batch,
                                             with_crc=True,

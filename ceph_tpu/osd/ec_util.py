@@ -159,17 +159,30 @@ class StripeInfo:
                                                       with_crc=True)
         else:
             parity = await batcher.encode(codec, arr)
+        # shard placement honors the codec's chunk remapping: data
+        # chunk i lives at position chunk_index(i), parity row r at the
+        # r-th coding position (layered codes like lrc interleave
+        # coding positions between data groups; identity-mapped codecs
+        # reduce to out[i]=data_i, out[k+r]=parity_r exactly as before)
+        cpos = self.coding_positions(codec)
         out: dict[int, np.ndarray] = {}
         for i in range(self.k):
-            out[i] = np.ascontiguousarray(arr[:, i]).reshape(-1)
+            out[codec.chunk_index(i)] = np.ascontiguousarray(
+                arr[:, i]).reshape(-1)
         for r in range(self.m):
-            out[self.k + r] = np.ascontiguousarray(
+            out[cpos[r]] = np.ascontiguousarray(
                 parity[:, r]).reshape(-1)
         if not with_crc:
             return out
         from ..ops.crc32c_batch import fold_chunk_crcs
         folded = fold_chunk_crcs(chunk_crcs, self.chunk_size)
-        return out, {i: int(folded[i]) for i in range(self.k + self.m)}
+        # folded column order is the launch order (data 0..k-1, then
+        # parity rows); re-key by shard position like `out`
+        crcs = {codec.chunk_index(i): int(folded[i])
+                for i in range(self.k)}
+        for r in range(self.m):
+            crcs[cpos[r]] = int(folded[self.k + r])
+        return out, crcs
 
     @staticmethod
     def _shard_crcs(shards: dict[int, np.ndarray]) -> dict[int, int]:
@@ -211,6 +224,34 @@ class StripeInfo:
             # lint: disable=device-path-host-sync -- view-normalizes gathered/cache-resident ndarrays (no copy, no transfer)
             return {i: np.asarray(shard_bufs[i], dtype=np.uint8)
                     for i in want}
+        if hasattr(codec, "decode_plan"):
+            # layered/regenerating codecs (ec/linear_codec.py) pick
+            # their OWN sources -- the LRC local group is fewer than k
+            # chunks, which the positional decode-index contract below
+            # cannot express -- and pack (sources, lost) into the
+            # batcher's grouping extra so same-pattern repairs share a
+            # launch
+            plan = codec.decode_plan(set(want), have)
+            if plan is not None:
+                src, lost = plan
+                survivors = np.stack(
+                    # lint: disable=device-path-host-sync -- the single input marshal: gathered buffers stacked once for the launch
+                    [np.asarray(shard_bufs[p], dtype=np.uint8)
+                     .reshape(n, cs) for p in src], axis=1)
+                rec = await batcher.decode(
+                    codec, codec.pack_decode_extra(src, lost),
+                    survivors)
+                out2: dict[int, np.ndarray] = {}
+                for i in want:
+                    if i in shard_bufs:
+                        # lint: disable=device-path-host-sync -- view passthrough of gathered shards alongside decoded ones
+                        out2[i] = np.asarray(shard_bufs[i],
+                                             dtype=np.uint8)
+                    else:
+                        out2[i] = np.ascontiguousarray(
+                            rec[:, lost.index(i)]).reshape(-1)
+                return out2
+            return self.decode(codec, shard_bufs, want)
         if len(erasures) > m or len(have) < k:
             # unrecoverable: let the per-stripe driver raise its
             # canonical IOError
@@ -247,6 +288,14 @@ class StripeInfo:
         k = codec.get_data_chunk_count()
         idx = getattr(codec, "chunk_index", None)
         return [idx(i) if idx else i for i in range(k)]
+
+    @classmethod
+    def coding_positions(cls, codec) -> list[int]:
+        """Shard ids hosting coding chunks, ascending (the order the
+        batched encode entry points emit parity rows in)."""
+        dpos = set(cls.data_positions(codec))
+        n = codec.get_chunk_count()
+        return [p for p in range(n) if p not in dpos]
 
     def decode(self, codec, shard_bufs: Mapping[int, np.ndarray],
                want: set[int] | None = None) -> dict[int, np.ndarray]:

@@ -1398,6 +1398,31 @@ class OSD:
             # echo what the requester ASKED for, so it can match the
             # reply to its plan independently of what we report below
             data["req_shard"] = int(msg.data["shard"])
+        if pg is not None and msg.data.get("frag_for") is not None:
+            # regenerating-code repair fragment: combine MY stored
+            # chunk by the codec's fragment row for the lost shard and
+            # ship beta-sized bytes instead of the whole chunk.  The
+            # fragment carries its own CRC plus this shard's write-time
+            # label/version so the aggregator can verify before mixing.
+            oid = msg.data["oid"]
+            backend = pg.backend
+            frag = backend.fragment_of(oid, int(msg.data["frag_for"])) \
+                if hasattr(backend, "fragment_of") else None
+            if frag is None:
+                data["frag_err"] = "ENOFRAG"
+            else:
+                fbuf, size, ver, label = frag
+                buf = fbuf
+                data["size"] = size
+                data["ver"] = list(ver)
+                data["frag_for"] = int(msg.data["frag_for"])
+                if label is not None:
+                    data["shard"] = int(label)
+                from .backend import shard_crc
+                data["crc"] = shard_crc(fbuf)
+            await conn.send(Message("ec_subop_read_reply", data,
+                                    segments=[buf]))
+            return
         if pg is not None:
             oid = msg.data["oid"]
             off = int(msg.data.get("off", 0))

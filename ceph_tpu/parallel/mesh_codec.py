@@ -241,13 +241,24 @@ class MeshCodec:
     # -- capability gate ----------------------------------------------------
     @staticmethod
     def supports(codec) -> bool:
-        """The mesh speaks the coefficient-matrix dialect of the jax
-        codec family (the ``encode_batch_crc`` marker): the encode
+        """The mesh speaks two coefficient-matrix dialects: the jax
+        codec family (the ``encode_batch_crc`` marker -- the encode
         matrix drives the launch directly and the decode matrix is the
-        same build_decode_matrix product decode_batch uses."""
+        same build_decode_matrix product decode_batch uses) and the
+        flat sub-chunk family (the ``mesh_flat_ok`` marker,
+        ec/linear_codec.py -- chunks reshape to alpha sub-chunk rows
+        around the same launches, matrices come from
+        ``parity_matrix``/``decode_flat_matrix``; fused CRC stays with
+        the first dialect, whose CRCs are chunk-granular)."""
+        if getattr(codec, "mesh_flat_ok", False):
+            return True
         return (hasattr(codec, "encode_batch_crc")
                 and getattr(codec, "encode_matrix", None) is not None
                 and not codec.get_chunk_mapping())
+
+    @staticmethod
+    def _flat(codec) -> bool:
+        return getattr(codec, "mesh_flat_ok", False)
 
     def pad_batch(self, total: int) -> int:
         """Bucketed launch batch: power-of-two (bounded jit cache) AND
@@ -345,6 +356,22 @@ class MeshCodec:
         re-scan).  ``out_np=False`` leaves the result on device (the
         pipelined batcher defers the materialization past its overlap
         window)."""
+        if self._flat(codec):
+            # sub-chunk dialect: (B, k, L) -> (B, k*alpha, L/alpha)
+            # rows around the same sharded launch; fused CRC is the
+            # other dialect's contract (the batcher routes CRC wants
+            # through the host batched pass for flat codecs)
+            assert not with_crc, "flat dialect has no fused CRC"
+            a = codec.alpha
+            b, kc, lane = batch.shape
+            out = self._apply(codec.parity_matrix,
+                              batch.reshape(b, kc * a, lane // a),
+                              False)
+            out = out.reshape(b, -1, lane)
+            if not out_np:
+                return out
+            # lint: disable=device-path-host-sync -- the single post-launch materialization
+            return np.asarray(out)
         mat = codec.encode_matrix[codec.k:]
         if not with_crc:
             out = self._apply(mat, batch, False)
@@ -367,6 +394,21 @@ class MeshCodec:
         """(B, k, L) survivors (decode-index order, the decode_batch
         contract) -> (B, len(erasures), L) recovered chunks."""
         erasures = tuple(int(e) for e in erasures)
+        if self._flat(codec):
+            # the packed (sources, lost) extra selects the SAME cached
+            # repair matrix decode_batch uses; survivors reshape to
+            # sub-chunk rows around the launch
+            matrix = codec.decode_flat_matrix(list(erasures))
+            a = codec.alpha
+            b, s, lane = batch.shape
+            out = self._apply(matrix,
+                              batch.reshape(b, s * a, lane // a),
+                              False)
+            out = out.reshape(b, -1, lane)
+            if not out_np:
+                return out
+            # lint: disable=device-path-host-sync -- the single post-launch materialization
+            return np.asarray(out)
         if hasattr(codec, "decode_matrix_for"):
             # the plugin's DecodeTableCache: the SAME matrix object
             # decode_batch would use
@@ -390,6 +432,16 @@ class MeshCodec:
         b, k, lane = delta.shape
         m = old_parity.shape[1]
         assert b % self.n_devices == 0, (b, self.n_devices)
+        if self._flat(codec):
+            # GF linearity holds per sub-chunk row identically
+            a = codec.alpha
+            out = self._rmw_flat(codec, old_parity, delta, a)
+            if self.perf is not None:
+                self.perf.inc("mesh_rmw_launches")
+            if not out_np:
+                return out
+            # lint: disable=device-path-host-sync -- the single post-launch materialization
+            return np.asarray(out)
         mat = np.ascontiguousarray(codec.encode_matrix[codec.k:],
                                    np.uint8)
         out = self._rmw_sched(mat, old_parity, delta)
@@ -404,6 +456,25 @@ class MeshCodec:
             return out
         # lint: disable=device-path-host-sync -- the single post-launch materialization
         return np.asarray(out)
+
+    def _rmw_flat(self, codec, old_parity: np.ndarray,
+                  delta: np.ndarray, a: int):
+        """Flat-dialect RMW: both operands reshape to sub-chunk rows,
+        then the standard scheduled/dense RMW ladder serves with the
+        codec's parity matrix."""
+        b, m, lane = old_parity.shape
+        k = delta.shape[1]
+        oldr = old_parity.reshape(b, m * a, lane // a)
+        deltar = delta.reshape(b, k * a, lane // a)
+        mat = codec.parity_matrix
+        out = self._rmw_sched(mat, oldr, deltar)
+        if out is None:
+            w = _w_device(self.mesh, mat.tobytes(), *mat.shape)
+            fn = _compiled_rmw(self.mesh, b, m * a, k * a, lane // a,
+                               self.donate)
+            out = fn(w, self._put(oldr), self._put(deltar))
+            self._count(b)
+        return out.reshape(b, m, lane)
 
     def _rmw_sched(self, mat: np.ndarray, old_parity: np.ndarray,
                    delta: np.ndarray):

@@ -82,14 +82,24 @@ class ChaosCluster(SimCluster):
         return data["result"]
 
     async def create_ec_pool(self, name: str, k: int, m: int,
-                             pg_num: int) -> None:
+                             pg_num: int, plugin: str = "tpu",
+                             profile_extra: dict | None = None) -> None:
+        """Create an EC pool through the standard registry path:
+        ``plugin`` picks the codec family (tpu RS, lrc, pmsr) and
+        ``profile_extra`` carries its extra parameters (l for lrc, d
+        for pmsr) -- the same knobs an operator sets, no special-cased
+        call sites."""
+        profile = {"plugin": plugin, "k": str(k), "m": str(m)}
+        if plugin == "tpu":
+            profile["technique"] = "reed_sol_van"
+        for key, val in (profile_extra or {}).items():
+            profile[key] = str(val)
+        pname = f"chaos-{plugin}-k{k}m{m}"
         await self.command("osd erasure-code-profile set", {
-            "name": f"chaos-k{k}m{m}",
-            "profile": {"plugin": "tpu", "k": str(k), "m": str(m),
-                        "technique": "reed_sol_van"}})
+            "name": pname, "profile": profile})
         await self.command("osd pool create", {
             "name": name, "type": "erasure", "pg_num": pg_num,
-            "erasure_code_profile": f"chaos-k{k}m{m}"})
+            "erasure_code_profile": pname})
 
     # -- data plane ----------------------------------------------------------
     def _target_for(self, pool_name: str, oid: str):
@@ -140,6 +150,89 @@ class ChaosCluster(SimCluster):
             raise TimeoutError(f"osd_op on {oid} never succeeded")
         finally:
             self.client.dispatchers.remove(d)
+
+async def recovery_round(c: ChaosCluster, *, rnd: random.Random,
+                         pool: str, n_objects: int, obj_size: int,
+                         kill_indices: list[int], log,
+                         settle: float = 90.0) -> dict:
+    """One kill -> degraded-write -> revive -> recover drive with the
+    repair I/O counted: objects written while the victim(s) are down
+    become missing shards, and the recovery that rebuilds them after
+    the revive is measured via the ``ec_recovery`` counters
+    (repair_bytes_read / repair_bytes_shipped -- the per-code repair
+    ratio the recovery-optimal codes exist to shrink).  Returns the
+    counter deltas, the recovery wall clock, and the post-recovery
+    byte-verification result (every object read back and compared,
+    with one of the ORIGINAL survivors killed so reads must use the
+    recovered shards -- a recovery that pushed garbage or absence
+    cannot pass)."""
+    result = {"errors": [], "mismatched": [], "n_objects": n_objects}
+    objs: dict[str, bytes] = {}
+    for i in range(n_objects):
+        data = rnd.getrandbits(8 * obj_size).to_bytes(obj_size,
+                                                      "little")
+        objs[f"rec-{i:04d}"] = data
+    # base pass so the pool's PGs are primed, then the degraded pass
+    # AFTER the kill is what creates the missing shards recovery must
+    # rebuild
+    for oid, data in objs.items():
+        await c.osd_op(pool, oid, [{"op": "writefull", "data": data}])
+    if not await c.wait_clean(settle):
+        result["errors"].append("cluster never went clean pre-kill")
+    tokens = []
+    for idx in kill_indices:
+        tok = await c.kill_osd(idx)
+        tokens.append((idx, tok))
+        if not await c.wait_down(tok["whoami"]):
+            result["errors"].append(
+                f"osd.{tok['whoami']} never marked down")
+            return result
+    log(f"  killed {[t['whoami'] for _, t in tokens]}; degraded "
+        f"rewrite of {n_objects} objects")
+    for oid, data in objs.items():
+        await c.osd_op(pool, oid, [{"op": "writefull", "data": data}])
+    rec0 = c.perf_counters("ec_recovery")
+    t0 = time.perf_counter()
+    for idx, tok in tokens:
+        await c.revive_osd(idx, tok)
+        if not await c.wait_up(tok["whoami"]):
+            result["errors"].append(
+                f"osd.{tok['whoami']} never came back")
+            return result
+    recovered = await c.wait_clean(settle)
+    wall = time.perf_counter() - t0
+    if not recovered:
+        result["errors"].append("recovery never converged")
+    rec1 = c.perf_counters("ec_recovery")
+    deltas = {key: rec1.get(key, 0) - rec0.get(key, 0)
+              for key in set(rec0) | set(rec1)}
+    # the proof: kill one of the ORIGINAL survivors, so every read of
+    # a degraded-phase object decodes THROUGH the recovered shards
+    survivor = next(i for i in range(len(c.osds))
+                    if i not in set(kill_indices))
+    tok2 = await c.kill_osd(survivor)
+    if not await c.wait_down(tok2["whoami"]):
+        result["errors"].append("verify-kill never marked down")
+    for oid, want in objs.items():
+        try:
+            reply = await asyncio.wait_for(
+                c.osd_op(pool, oid,
+                         [{"op": "read", "off": 0, "len": None}],
+                         timeout=10, retries=8), timeout=60)
+        except (TimeoutError, asyncio.TimeoutError):
+            result["mismatched"].append(oid)
+            continue
+        r = reply.data["results"][0]
+        data = reply.segments[r["seg"]] if "seg" in r else None
+        if not r.get("ok") or data != want:
+            result["mismatched"].append(oid)
+    await c.revive_osd(survivor, tok2)
+    await c.wait_up(tok2["whoami"])
+    result.update({"recovery_wall_s": round(wall, 3),
+                   "recovered_clean": recovered,
+                   "repair": deltas})
+    return result
+
 
 async def run_round(c: ChaosCluster, *, rnd: random.Random,
                     pool: str, n_objects: int, min_size: int,
@@ -201,6 +294,74 @@ async def run_round(c: ChaosCluster, *, rnd: random.Random,
     return result
 
 
+async def repair_pin_drive(c: ChaosCluster, args, rnd: random.Random,
+                           log) -> int:
+    """--repair-pin: the per-code repair-byte assertion.  Kill one
+    OSD, write degraded, revive, recover, and pin the measured
+    ``ec_recovery`` read/shipped ratio against the code's repair
+    math: LRC single-failure recovery must read <= (l+1)x the shipped
+    bytes ((l+1)/k of what RS would read), pmsr must take the
+    fragment path and read under k full chunks, and a second round
+    with TWO victims pins the multi-failure fallback (global decodes
+    engaged, still byte-correct)."""
+    failures = 0
+    res = await recovery_round(
+        c, rnd=rnd, pool="chaospool", n_objects=args.objects,
+        obj_size=args.max_size, kill_indices=[len(c.osds) - 1],
+        log=log)
+    rep = res.get("repair", {})
+    log(f"  single-failure repair: {rep} "
+        f"wall={res.get('recovery_wall_s')}s")
+    if res["errors"] or res["mismatched"]:
+        log(f"ERROR: {res['errors']} mismatched={res['mismatched']}")
+        failures += 1
+    read = rep.get("repair_bytes_read", 0)
+    shipped = rep.get("repair_bytes_shipped", 0)
+    if not shipped or not read:
+        log("ERROR: recovery moved no counted bytes")
+        failures += 1
+    elif args.plugin == "lrc":
+        bound = (args.l + 1) * shipped
+        if read > bound:
+            log(f"ERROR: lrc repair read {read} > (l+1)*shipped="
+                f"{bound} (locality not engaged)")
+            failures += 1
+        if not rep.get("repair_local_repairs"):
+            log("ERROR: no local repair recorded")
+            failures += 1
+    elif args.plugin == "pmsr":
+        if not rep.get("repair_fragment_pulls"):
+            log("ERROR: no fragment pull recorded")
+            failures += 1
+        if read >= args.k * shipped:
+            log(f"ERROR: pmsr repair read {read} >= k*shipped="
+                f"{args.k * shipped} (no better than RS)")
+            failures += 1
+    # the multi-failure fallback pin is the LAYERED code's contract
+    # (local repair infeasible when a group loses two chunks); a
+    # 2-kill on an MDS-width pmsr pool at m=2 would drop the pool
+    # below min_size instead
+    if args.plugin == "lrc" and len(c.osds) >= 2:
+        res2 = await recovery_round(
+            c, rnd=rnd, pool="chaospool", n_objects=args.objects,
+            obj_size=args.max_size,
+            kill_indices=[len(c.osds) - 1, len(c.osds) - 2],
+            log=log)
+        rep2 = res2.get("repair", {})
+        log(f"  multi-failure repair: {rep2} "
+            f"wall={res2.get('recovery_wall_s')}s")
+        if res2["errors"] or res2["mismatched"]:
+            log(f"ERROR: multi-failure {res2['errors']} "
+                f"mismatched={res2['mismatched']}")
+            failures += 1
+        if args.plugin == "lrc" and not rep2.get(
+                "repair_global_decodes"):
+            log("ERROR: multi-failure recovery never fell back to "
+                "global decode")
+            failures += 1
+    return failures
+
+
 async def chaos_main(args) -> int:
     rnd = random.Random(args.seed)
     faults = None
@@ -223,8 +384,22 @@ async def chaos_main(args) -> int:
         if not args.quiet:
             print(msg, flush=True)
 
+    extra = {}
+    if args.plugin == "lrc" and args.l:
+        extra["l"] = args.l
+    if args.plugin == "pmsr" and args.d:
+        extra["d"] = args.d
     try:
-        await c.create_ec_pool("chaospool", args.k, args.m, args.pg_num)
+        await c.create_ec_pool("chaospool", args.k, args.m,
+                               args.pg_num, plugin=args.plugin,
+                               profile_extra=extra)
+        if args.repair_pin:
+            failures += await repair_pin_drive(c, args, rnd, log)
+            deg = c.perf_counters("ec_degraded")
+            log(f"ec_degraded counters: {deg}")
+            log(f"{'FAIL' if failures else 'PASS'}: "
+                f"{failures} failures")
+            return 1 if failures else 0
         for r in range(args.rounds):
             log(f"round {r + 1}/{args.rounds}")
             kill_index = (len(c.osds) - 1 if args.kill_last
@@ -283,6 +458,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--osds", type=int, default=3)
     p.add_argument("--k", type=int, default=2)
     p.add_argument("--m", type=int, default=1)
+    p.add_argument("--plugin", default="tpu",
+                   choices=("tpu", "lrc", "pmsr"),
+                   help="EC plugin for the pool (registry path)")
+    p.add_argument("--l", type=int, default=0,
+                   help="lrc locality parameter (chunks per local "
+                        "group beside its parity)")
+    p.add_argument("--d", type=int, default=0,
+                   help="pmsr helper count (must be 2(k-1))")
+    p.add_argument("--repair-pin", action="store_true",
+                   help="kill/recover drive asserting the per-code "
+                        "repair-byte ratio via the ec_recovery "
+                        "counters instead of the read-back rounds")
     p.add_argument("--pg-num", type=int, default=16)
     p.add_argument("--min-size", type=int, default=8 << 10)
     p.add_argument("--max-size", type=int, default=32 << 10)

@@ -10,6 +10,12 @@ Two sweeps, both parity-gated against the host oracle:
     chunk), recording the winner under the "xor_sched" key of
     ceph_tpu/ops/gf2_tuned.json -- the cost model
     (xor_schedule.want_scheduled) serves it by default from then on.
+    ``--codes lrc,pmsr`` extends this sweep to the recovery-code
+    matrix families (LRC local-parity/local-repair rows, PMSR
+    parity/fragment-aggregate matrices): exactly the sparse GF(2)
+    shapes where the schedule should win on CPU, keyed by their
+    matrix dims (the key the runtime cost model looks up; same dims
+    = same kernel family, so the winner transfers).
 
 The reference tunes its SIMD technique per-CPU at plugin load
 (src/erasure-code/isa/ErasureCodeIsa.cc picks AVX2/AVX512 paths); this
@@ -116,21 +122,68 @@ def sweep(k: int, m: int, batch: int, chunk: int,
 
 def sweep_engines(k: int, m: int, batch: int, chunk: int,
                   iters: int = 8) -> dict | None:
-    """Dense vs scheduled on one (k, m, batch, chunk) shape: time the
+    """Dense vs scheduled on the RS (k, m) parity matrix (the
+    headline family): see ``sweep_matrix_engines``."""
+    from ..gf import gen_rs_matrix
+    gen = gen_rs_matrix(k + m, k)
+    return sweep_matrix_engines(
+        np.ascontiguousarray(gen[k:], np.uint8), batch, chunk,
+        iters=iters)
+
+
+def code_matrices(codes: list[str],
+                  smoke: bool = False) -> list[tuple[str, np.ndarray]]:
+    """The recovery-code GF(2^8) matrix families worth a tuned entry:
+    LRC local-parity/local-repair rows and PMSR parity/repair-
+    aggregate matrices -- the sparse shapes where the CSE-minimized
+    schedule should beat the dense contraction on CPU.  Tags name the
+    provenance; the tuned keys are derived from the matrix dims (the
+    same key ``want_scheduled`` looks up at run time).  Smoke swaps
+    the pmsr shape down to k=3 so the tier-1 harness never pays the
+    dense k=5 parity matrix's multi-second CSE pass."""
+    from ..ec import registry
+    out: list[tuple[str, np.ndarray]] = []
+    if "lrc" in codes:
+        lrc = registry().factory(
+            "lrc", {"k": "8", "m": "4", "l": "3"})
+        out.append(("lrc_k8m4l3_parity", lrc.parity_matrix))
+        # single-loss local repair: the lost chunk over its group
+        lost = 0
+        src = tuple(sorted(
+            lrc.minimum_to_decode({lost},
+                                  set(range(16)) - {lost}).keys()))
+        out.append(("lrc_k8m4l3_local_repair",
+                    lrc.repair_matrix(src, (lost,))))
+    if "pmsr" in codes:
+        pk, pm = (3, 2) if smoke else (5, 4)
+        pmsr = registry().factory("pmsr",
+                                  {"k": str(pk), "m": str(pm)})
+        out.append((f"pmsr_k{pk}m{pm}_parity", pmsr.parity_matrix))
+        helpers = tuple(range(1, 1 + pmsr.d))
+        out.append((f"pmsr_k{pk}m{pm}_aggregate",
+                    pmsr.aggregate_matrix(0, helpers)))
+    return out
+
+
+def sweep_matrix_engines(mat: np.ndarray, batch: int, lane: int,
+                         iters: int = 8,
+                         tag: str = "") -> dict | None:
+    """Dense vs scheduled on one (matrix, batch, lane) shape: time the
     dense bit-matmul family against the CSE-minimized XOR schedule on
     identical device-resident batches, byte-parity-gate both against
     the host oracle, and return the winner record the cost model
     consumes (None when the scheduled family cannot serve)."""
     import os
-    from ..gf import gen_rs_matrix, gf_matmul
+    from ..gf import gf_matmul
     from ..ops import gf2kernels as G
     from ..ops import xor_schedule as XS
 
-    gen = gen_rs_matrix(k + m, k)
-    mat = np.ascontiguousarray(gen[k:], np.uint8)
+    mat = np.ascontiguousarray(mat, np.uint8)
+    m, k = mat.shape
     rng = np.random.default_rng(0)
-    xd = stage_batch(rng, batch, k, chunk)
-    sample = np.asarray(xd[:1, :, :512])
+    xd = stage_batch(rng, batch, k, lane)
+    ncheck = min(512, lane)
+    sample = np.asarray(xd[:1, :, :ncheck])
     want = gf_matmul(mat, sample[0])
 
     def timed(fn) -> tuple[float, np.ndarray]:
@@ -141,7 +194,7 @@ def sweep_engines(k: int, m: int, batch: int, chunk: int,
             out = fn()
         out.block_until_ready()
         return (time.perf_counter() - t0) / iters, \
-            np.asarray(out[:1, :, :512])
+            np.asarray(out[:1, :, :ncheck])
 
     os.environ["CEPH_TPU_XOR_SCHED"] = "0"
     try:
@@ -156,7 +209,7 @@ def sweep_engines(k: int, m: int, batch: int, chunk: int,
 
     def run_sched():
         out = XS.sched_matmul_batch_device(sched, mat, xd, batch, k,
-                                           chunk)
+                                           lane)
         if out is None:
             raise RuntimeError("scheduled kernel rejected")
         return out
@@ -170,7 +223,7 @@ def sweep_engines(k: int, m: int, batch: int, chunk: int,
     if not np.array_equal(got_sched[0], want):
         log("engine sweep: scheduled PARITY FAIL")
         return None
-    gibps = lambda dt: batch * k * chunk / dt / 2**30  # noqa: E731
+    gibps = lambda dt: batch * k * lane / dt / 2**30  # noqa: E731
     rec = {
         "engine": "scheduled" if dt_sched < dt_dense else "dense",
         "dense_gibps": round(gibps(dt_dense), 3),
@@ -179,9 +232,9 @@ def sweep_engines(k: int, m: int, batch: int, chunk: int,
         "naive_terms": sched.naive_terms,
         "reduction_pct": round(100 * sched.reduction, 1),
     }
-    log(f"engine sweep k={k} m={m} batch={batch} chunk={chunk}: "
-        f"dense={rec['dense_gibps']} GiB/s sched={rec['sched_gibps']}"
-        f" GiB/s -> {rec['engine']} "
+    log(f"engine sweep {tag or f'{k},{m}'} batch={batch} "
+        f"lane={lane}: dense={rec['dense_gibps']} GiB/s "
+        f"sched={rec['sched_gibps']} GiB/s -> {rec['engine']} "
         f"(xor terms {sched.n_terms}/{sched.naive_terms})")
     return rec
 
@@ -218,6 +271,11 @@ def main(argv=None) -> int:
     ap.add_argument("--cpu-smoke", action="store_true",
                     help="tier-1 harness mode: tiny shapes, skip the "
                          "pallas sweep, engine sweep only")
+    ap.add_argument("--codes", default="",
+                    help="comma list of recovery-code matrix families "
+                         "to sweep into xor_sched entries (lrc,pmsr): "
+                         "local-parity / repair / fragment-aggregate "
+                         "matrices keyed by their matrix dims")
     args = ap.parse_args(argv)
 
     import jax
@@ -233,13 +291,29 @@ def main(argv=None) -> int:
                         args.budget_s)
         if not results:
             log("no working pallas config found")
+    iters = 2 if args.cpu_smoke else 8
     engines = sweep_engines(args.k, args.m, args.batch, args.chunk,
-                            iters=2 if args.cpu_smoke else 8)
-    if not results and engines is None:
+                            iters=iters)
+    code_recs: dict[str, dict] = {}
+    codes = [c for c in args.codes.split(",") if c]
+    for tag, mat in code_matrices(codes, smoke=args.cpu_smoke):
+        r, c = mat.shape
+        # lane at the granularity the runtime launches with: the flat
+        # sub-chunk dialect reshapes chunks, so tune at a sub-lane
+        lane = max(512, min(args.chunk, 4096)) if args.cpu_smoke \
+            else args.chunk
+        rec = sweep_matrix_engines(mat, args.batch, lane,
+                                   iters=iters, tag=tag)
+        if rec is not None:
+            rec["tag"] = tag
+            code_recs[f"{c},{r}"] = rec
+    if not results and engines is None and not code_recs:
         log("no working config found")
         return 1
     report = {"k": args.k, "m": args.m, "chunk": args.chunk,
               "xor_sched": engines}
+    if code_recs:
+        report["xor_sched_codes"] = code_recs
     if results:
         report["best"] = results[0]
         report["top5"] = results[:5]
@@ -252,11 +326,15 @@ def main(argv=None) -> int:
             update[str(args.k)] = {kk: results[0][kk] for kk in
                                    ("g", "unpack", "mm", "pack",
                                     "tile")}
+        sched_update = {}
         if engines is not None:
-            update["xor_sched"] = {
+            sched_update.update({
                 f"{args.k},{args.m},{args.chunk}": engines,
                 f"{args.k},{args.m}": engines,
-            }
+            })
+        sched_update.update(code_recs)
+        if sched_update:
+            update["xor_sched"] = sched_update
         _write_tuned(path, update)
     return 0
 

@@ -27,3 +27,13 @@ class HedgedGather:
 
     def _collect(self, plan):
         return [np.asarray(buf) for buf in plan.values()]
+
+
+class LinearSubchunkCodec:
+    # the flat lrc/pmsr launch entry points are roots too: the
+    # sub-chunk reshape must stay a view, never a host materialization
+    def encode_batch(self, data, out_np=False):
+        return self._reshaped(data)
+
+    def _reshaped(self, data):
+        return np.asarray(data).reshape(-1)

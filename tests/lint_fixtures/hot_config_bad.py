@@ -17,3 +17,18 @@ class CodecBatcher:
 
     def _cap(self):
         return int(self.config.get("osd_ec_batch_max", 64))
+
+
+class ECBackend:
+    def __init__(self, config):
+        self.config = config
+
+    async def read_recovery_payload(self, oid, shard):
+        # the repair path runs per rebuilt shard: this gate must be a
+        # construction-time snapshot, not a per-repair dict probe
+        if self.config.get("osd_ec_repair_fragments_enabled", True):
+            return await self._fragment_recover(oid, shard)
+        return None
+
+    async def _fragment_recover(self, oid, shard):
+        return None

@@ -22,3 +22,18 @@ class CodecBatcher:
 def unrelated_admin_handler(config):
     # not reachable from any launch-loop root: reads are fine here
     return config.get("debug_osd", 1)
+
+
+class ECBackend:
+    def __init__(self, config):
+        # snapshot once; the repair path closes over the value
+        self._frag_repair = bool(
+            config.get("osd_ec_repair_fragments_enabled", True))
+
+    async def read_recovery_payload(self, oid, shard):
+        if self._frag_repair:
+            return await self._fragment_recover(oid, shard)
+        return None
+
+    async def _fragment_recover(self, oid, shard):
+        return None

@@ -277,6 +277,42 @@ def test_straggler_smoke_gates_hold():
     assert res["hedged"]["gather_retries"] == 0
 
 
+def test_recovery_smoke_gates_hold():
+    """bench.py --recovery --smoke is the tier-1 tripwire for the
+    recovery-bandwidth-optimal codes: the same kill/recover drive on
+    RS vs LRC vs PMSR pools must converge byte-correct with zero
+    failed objects, LRC single-failure repair must read <= 0.5x the
+    RS bytes through the local group, and PMSR must take the
+    fragment path with helper traffic under k full chunks -- all via
+    the ec_recovery counters, never assumed."""
+    import json
+    import os
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--recovery", "--smoke"],
+        capture_output=True, text=True, cwd="/root/repo", env=env,
+        timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    res = json.loads(r.stdout.strip().splitlines()[-1])
+    assert res["metric"] == "recovery_repair_read_ratio_lrc_vs_rs"
+    assert 0 < res["value"] <= 0.5
+    assert res["failed_objects"] == 0 and res["errors"] == 0
+    codes = res["codes"]
+    for name, c in codes.items():
+        assert c["recovered_clean"], name
+        assert c["repair_bytes_shipped"] > 0, name
+        assert c["mismatched"] == [], name
+    # RS reads k full chunks per rebuilt shard; LRC the local group;
+    # PMSR d beta-fragments (d/alpha chunks, strictly under k)
+    assert codes["rs"]["read_per_shipped"] == codes["rs"]["k"]
+    assert codes["lrc"]["read_per_shipped"] <= codes["lrc"]["l"] + 1
+    assert codes["lrc"]["repair_local_repairs"] > 0
+    assert 0 < codes["pmsr"]["read_per_shipped"] < codes["pmsr"]["k"]
+    assert codes["pmsr"]["repair_fragment_pulls"] > 0
+
+
 def test_placement_smoke_exits_zero_with_fused_parity():
     """bench.py --placement --smoke is the tier-1 tripwire for
     fused/scalar placement divergence: it forces the fused path on a

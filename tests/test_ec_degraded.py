@@ -183,6 +183,86 @@ def test_ec_subop_read_reports_write_time_identity():
     run(main())
 
 
+def test_recovery_repair_bytes_per_code():
+    """The per-code repair-byte pin (the recovery-optimal-code
+    contract, measured not assumed): a kill -> degraded-write ->
+    revive -> recover drive on an LRC pool reads l chunks per rebuilt
+    shard (<= (l+1)/k of the RS byte count) through the local group,
+    and the same drive on a pmsr pool takes the fragment path (d
+    beta-sized fragments = d/alpha chunks, under k).  Both verified
+    byte-identical against a survivor kill, so the reads MUST decode
+    through the recovered shards."""
+    import random
+    from ceph_tpu.tools.chaos import ChaosCluster, recovery_round
+
+    async def drive(plugin, k, m, extra, n_osds):
+        c = await ChaosCluster.create(
+            n_osds,
+            mon_config={"mon_osd_down_out_interval": 3600.0},
+            osd_config={"osd_heartbeat_interval": 0.2,
+                        "osd_heartbeat_grace": 3.0})
+        try:
+            await c.create_ec_pool("recpool", k, m, 4, plugin=plugin,
+                                   profile_extra=extra)
+            res = await recovery_round(
+                c, rnd=random.Random(7), pool="recpool",
+                n_objects=3, obj_size=8 << 10,
+                kill_indices=[n_osds - 1], log=lambda *_: None)
+            assert res["errors"] == [], res
+            assert res["mismatched"] == [], res
+            assert res["recovered_clean"], res
+            return res["repair"]
+        finally:
+            await c.stop()
+
+    async def main():
+        # LRC k=4 m=2 l=3 (width 8): local repair reads l=3 chunks
+        rep = await drive("lrc", 4, 2, {"l": 3}, 8)
+        read = rep["repair_bytes_read"]
+        shipped = rep["repair_bytes_shipped"]
+        assert shipped > 0 and read > 0
+        assert read <= (3 + 1) * shipped, rep     # <= (l+1)/k of RS
+        assert rep.get("repair_local_repairs", 0) > 0
+        # pmsr k=3 m=2 (width 5): d=4 fragments of chunk/alpha each
+        rep = await drive("pmsr", 3, 2, {}, 5)
+        read = rep["repair_bytes_read"]
+        shipped = rep["repair_bytes_shipped"]
+        assert shipped > 0 and read > 0
+        assert rep.get("repair_fragment_pulls", 0) > 0
+        assert read < 3 * shipped, rep            # under k full chunks
+        assert read == 2 * shipped, rep           # exactly d/alpha
+    run(main())
+
+
+def test_lrc_multi_failure_recovery_falls_back_to_global():
+    """Two victims: local groups holding both losses cannot repair
+    locally, so recovery engages the global decode -- and still
+    converges byte-correct (the fallback pin)."""
+    import random
+    from ceph_tpu.tools.chaos import ChaosCluster, recovery_round
+
+    async def main():
+        c = await ChaosCluster.create(
+            8, mon_config={"mon_osd_down_out_interval": 3600.0},
+            osd_config={"osd_heartbeat_interval": 0.2,
+                        "osd_heartbeat_grace": 3.0})
+        try:
+            await c.create_ec_pool("recpool", 4, 2, 8, plugin="lrc",
+                                   profile_extra={"l": 3})
+            res = await recovery_round(
+                c, rnd=random.Random(11), pool="recpool",
+                n_objects=4, obj_size=8 << 10,
+                kill_indices=[7, 6], log=lambda *_: None)
+            assert res["errors"] == [], res
+            assert res["mismatched"] == [], res
+            rep = res["repair"]
+            # at least one PG lost two chunks of one group: global
+            assert rep.get("repair_global_decodes", 0) > 0, rep
+        finally:
+            await c.stop()
+    run(main())
+
+
 @pytest.mark.slow
 def test_degraded_read_repro_24_objects():
     """ROADMAP repro, pinned: 24 objects of 8-32 KiB on k=2,m=1

@@ -228,6 +228,102 @@ def test_lrc_beyond_capability_raises(registry):
         codec.minimum_to_decode({0, 1, 2}, avail)
 
 
+def test_lrc_validation_messages_and_layer_order(registry):
+    """Profile validation EINVALs fire at parse time with actionable
+    messages (the monitor instantiates the plugin at profile-set AND
+    pool-create, so both gates reject), and an ill-ordered layers
+    profile -- a layer reading a position nothing computed yet, which
+    the old per-layer encode silently zero-filled -- is refused."""
+    with pytest.raises(ValueError, match="all of k, m, l"):
+        registry.factory("lrc", {"k": "4", "l": "3"})
+    with pytest.raises(ValueError, match="l=0 must be >= 1"):
+        registry.factory("lrc", {"k": "4", "m": "2", "l": "0"})
+    with pytest.raises(ValueError, match="mapping cannot be set"):
+        registry.factory("lrc", {"k": "4", "m": "2", "l": "3",
+                                 "mapping": "DD__"})
+    import json
+    with pytest.raises(ValueError, match="before any layer computes"):
+        # the FIRST layer reads position 2, which only the SECOND
+        # layer computes: the old per-layer encode silently used zeros
+        registry.factory("lrc", {
+            "mapping": "DD__",
+            "layers": json.dumps([["DDDc", ""], ["DDc_", ""]])})
+
+
+def test_lrc_flat_generator_matches_layered_encode(registry):
+    """The flat generator composition is byte-identical to driving
+    the layer stack explicitly (the pre-flat implementation's
+    semantics): each coding position's bytes equal its layer's RS
+    parity over the layer inputs."""
+    from ceph_tpu.gf import gf_matmul
+    codec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    data = rand_bytes(4 * 96, seed=21)
+    chunks = codec.encode(set(range(n)), data)
+    for layer in codec.layers:
+        src = np.stack([chunks[p] for p in layer.data_pos])
+        parity = gf_matmul(layer.matrix[layer.k:], src)
+        for r, p in enumerate(layer.coding_pos):
+            assert np.array_equal(chunks[p], parity[r]), (
+                layer.mapping, p)
+
+
+def test_lrc_batched_launches_match_host(registry):
+    """The mapped layout rides the CodecBatcher (padding buckets +
+    scheduled/dense kernels): encode_async/decode_async byte-parity
+    vs the per-stripe host driver, including a LOCAL batched repair
+    (sources fewer than k, inexpressible in the positional
+    decode-index dialect)."""
+    import asyncio
+    from ceph_tpu.osd.codec_batcher import CodecBatcher
+    from ceph_tpu.osd.ec_util import StripeInfo
+    codec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    assert CodecBatcher.supports(codec)
+    sinfo = StripeInfo.for_codec(codec, 1024)
+    data = rand_bytes(sinfo.stripe_width * 3, seed=23)
+    host = sinfo.encode(codec, data)
+
+    async def drive():
+        batcher = CodecBatcher(max_batch=8, mesh=None)
+        shards = await sinfo.encode_async(codec, data,
+                                          batcher=batcher)
+        for i in host:
+            assert np.array_equal(host[i], shards[i]), i
+        n = codec.get_chunk_count()
+        for lost in range(n):
+            have = {i: shards[i] for i in range(n) if i != lost}
+            got = await sinfo.decode_async(codec, have, want={lost},
+                                           batcher=batcher)
+            assert np.array_equal(got[lost], shards[lost]), lost
+        out = await sinfo.reconstruct_logical_async(
+            codec, {i: shards[i] for i in range(n) if i != 0},
+            batcher=batcher)
+        assert out == data
+        batcher.close()
+
+    asyncio.new_event_loop().run_until_complete(drive())
+
+
+def test_lrc_local_repair_bytes_equal_global_decode(registry):
+    """The same failure decoded two ways -- from the local group only
+    and from a k-wide global set -- produces identical bytes (both
+    are exact solutions of the generator identity)."""
+    codec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    data = rand_bytes(4 * 96, seed=25)
+    chunks = codec.encode(set(range(n)), data)
+    lost = 1
+    local = set(codec.minimum_to_decode({lost},
+                                        set(range(n)) - {lost}))
+    assert len(local) == 3                       # the group, not k
+    dec_local = codec.decode({lost},
+                             {i: chunks[i] for i in local})
+    glob = {i: chunks[i] for i in range(n) if i != lost}
+    dec_global = codec.decode({lost}, glob)
+    assert np.array_equal(dec_local[lost], dec_global[lost])
+    assert np.array_equal(dec_local[lost], chunks[lost])
+
+
 def test_lrc_baseline_config_k12_m4_l4(registry):
     """The multi-chip BASELINE shape: 4 local groups mapping onto a
     4-way mesh axis (parallel/sharded_ec.py lrc_local_repair)."""

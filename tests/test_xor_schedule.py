@@ -364,6 +364,46 @@ def test_autotune_cpu_smoke_writes_winner(tmp_path, capsys):
     assert "4,2,4096" in tuned["xor_sched"]
 
 
+def test_autotune_code_matrices_sweep(tmp_path, capsys):
+    """--codes sweeps the recovery-code matrix families (LRC
+    local-parity/local-repair, PMSR parity/fragment-aggregate) into
+    xor_sched entries keyed by their matrix dims -- the key the
+    runtime cost model looks up."""
+    from ceph_tpu.tools import ec_autotune
+    out = tmp_path / "tuned.json"
+    rc = ec_autotune.main(["--k", "4", "--m", "2", "--cpu-smoke",
+                           "--codes", "lrc,pmsr",
+                           "--write", "--out", str(out)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    recs = report["xor_sched_codes"]
+    tags = {r["tag"] for r in recs.values()}
+    assert "lrc_k8m4l3_parity" in tags
+    assert "lrc_k8m4l3_local_repair" in tags
+    assert any(t.startswith("pmsr_") and t.endswith("_aggregate")
+               for t in tags)
+    tuned = json.loads(out.read_text())
+    # the LRC parity family key (8 data cols, 8 coding rows)
+    assert "8,8" in tuned["xor_sched"]
+    # the local-repair row: 3 sources -> 1 lost chunk
+    assert "3,1" in tuned["xor_sched"]
+    for rec in recs.values():
+        assert rec["engine"] in ("dense", "scheduled")
+
+
+def test_speculative_compile_bound_protects_codec_init():
+    """Dense matrices above SPECULATIVE_MAX_CELLS are neither warmed
+    at codec build time nor compiled by the CPU backend heuristic --
+    a multi-second greedy-CSE pass must never ride profile validation
+    or a first launch.  Explicit opt-ins (env, tuned entry) still
+    compile."""
+    rng = np.random.default_rng(0)
+    big = rng.integers(0, 2, size=(160, 160), dtype=np.uint8)
+    assert big.size > XS.SPECULATIVE_MAX_CELLS
+    assert XS.want_scheduled(big, 4096, "cpu") is None
+    assert XS.cached_schedule(big) is None       # nothing compiled
+
+
 def test_tuned_winner_steers_cost_model(tmp_path, monkeypatch):
     """A gf2_tuned.json xor_sched entry overrides the backend
     heuristic in both directions."""

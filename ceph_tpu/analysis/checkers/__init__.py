@@ -1,9 +1,11 @@
 """Built-in project rules; importing this package registers them."""
 
 from . import (        # noqa: F401
+    await_snapshot,
     await_under_lock,
     blocking_under_lock,
     config_schema,
+    cross_daemon_state,
     counter_coverage,
     denc_symmetry,
     device_path,
@@ -15,5 +17,6 @@ from . import (        # noqa: F401
     lock_order,
     perf_coherence,
     tracer_safety,
+    wire_safety,
     x64_scope,
 )

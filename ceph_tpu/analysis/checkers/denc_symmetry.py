@@ -47,6 +47,43 @@ def _leaf(node: ast.AST) -> str | None:
     return astutil.name_leaf(node)
 
 
+def _const_keys_written(root: ast.AST) -> set[str]:
+    """Constant string keys a frame packer produces: dict-literal
+    keys plus constant subscript stores."""
+    out: set[str] = set()
+    for node in ast.walk(root):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                ks = astutil.const_str(k) if k is not None else None
+                if ks is not None:
+                    out.add(ks)
+        elif (isinstance(node, ast.Subscript)
+              and isinstance(node.ctx, ast.Store)):
+            ks = astutil.const_str(node.slice)
+            if ks is not None:
+                out.add(ks)
+    return out
+
+
+def _const_keys_read(root: ast.AST) -> set[str]:
+    """Constant string keys a frame unpacker consumes: constant
+    subscript loads plus ``.get("k", ...)`` calls."""
+    out: set[str] = set()
+    for node in ast.walk(root):
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)):
+            ks = astutil.const_str(node.slice)
+            if ks is not None:
+                out.add(ks)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "get" and node.args):
+            ks = astutil.const_str(node.args[0])
+            if ks is not None:
+                out.add(ks)
+    return out
+
+
 class _SeqExtractor:
     """Ordered denc-primitive sequence of one function body."""
 
@@ -161,9 +198,13 @@ class DencSymmetry(ProjectChecker):
     def check_project(self, graph: CallGraph) -> Iterable[Finding]:
         for path in sorted(graph.symbols):
             syms = graph.symbols[path]
-            if not astutil.imports_module(syms.module.tree, "denc"):
-                continue
-            yield from self._check_module(syms)
+            if astutil.imports_module(syms.module.tree, "denc"):
+                yield from self._check_module(syms)
+            # pack_X/unpack_X frame pairs and the WIRE_CODECS table
+            # are wire vocabulary whether or not the module drives a
+            # denc Encoder itself (messenger.py does not import denc)
+            yield from self._check_pack_pairs(syms)
+            yield from self._check_wire_codecs(syms)
 
     def _check_module(self, syms) -> Iterable[Finding]:
         pairs: list[tuple] = []
@@ -190,6 +231,70 @@ class DencSymmetry(ProjectChecker):
                 continue        # full delegation: nothing to compare
             yield from self._compare(enc_label, enc_seq, dec_label,
                                      dec_fi, dec_seq)
+
+    def _check_pack_pairs(self, syms) -> Iterable[Finding]:
+        """``pack_X``/``unpack_X`` frame pairs (the SubOpPipe batch
+        vocabulary): every constant dict key the unpacker reads must
+        be one the packer writes.  Write-only keys are fine -- length
+        metadata can serve other consumers -- but a read of a key the
+        encoder never produces is a silent ``None``/KeyError on every
+        frame."""
+        for name, fi in syms.top_funcs.items():
+            if not name.startswith("pack_"):
+                continue
+            unpack = syms.top_funcs.get("un" + name)
+            if unpack is None:
+                continue
+            written = _const_keys_written(fi.node)
+            written |= _const_keys_written(unpack.node)
+            missing = sorted(_const_keys_read(unpack.node) - written)
+            if missing:
+                yield Finding(
+                    unpack.path, unpack.lineno, self.name,
+                    f"{unpack.name} reads key(s) "
+                    f"{', '.join(repr(k) for k in missing)} that "
+                    f"{name} never writes -- the frame vocabulary "
+                    f"is asymmetric; every unpack of a real frame "
+                    f"sees the key missing")
+
+    def _check_wire_codecs(self, syms) -> Iterable[Finding]:
+        """Each ``WIRE_CODECS`` entry must map a wire type to a
+        conventionally-paired codec whose name matches the type --
+        mapping ``"rep_op_reply"`` to ``_enc_rep_op`` by copy-paste
+        would silently encode the wrong fixed layout."""
+        for stmt in syms.module.tree.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "WIRE_CODECS"
+                    and isinstance(stmt.value, ast.Dict)):
+                continue
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                ks = astutil.const_str(k) if k is not None else None
+                if ks is None or not isinstance(v, ast.Tuple) \
+                        or len(v.elts) != 2:
+                    continue
+                enc = _leaf(v.elts[0]) or ""
+                dec = _leaf(v.elts[1]) or ""
+                es = enc[len("_enc_"):] if enc.startswith("_enc_") \
+                    else None
+                ds = dec[len("_dec_"):] if dec.startswith("_dec_") \
+                    else None
+                if es is None or ds is None or es != ds:
+                    yield Finding(
+                        syms.module.path, k.lineno, self.name,
+                        f"WIRE_CODECS['{ks}'] pairs '{enc}' with "
+                        f"'{dec}' -- not a matched _enc_X/_dec_X "
+                        f"pair; the decoder cannot be assumed to "
+                        f"consume what the encoder wrote")
+                elif es != ks:
+                    yield Finding(
+                        syms.module.path, k.lineno, self.name,
+                        f"WIRE_CODECS['{ks}'] maps to the "
+                        f"'{es}' codec pair -- a type borrowing "
+                        f"another type's layout is a copy-paste "
+                        f"hazard; give it its own pair or justify "
+                        f"the shared layout")
 
     def _sequence(self, fi) -> list[tuple]:
         recv = self._receiver(fi)

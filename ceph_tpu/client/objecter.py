@@ -116,15 +116,27 @@ class Objecter:
 
         async def d(conn, msg):
             if msg.type == "osdmap_full":
-                await q.put(msg.data["map"])
+                await q.put(("full", msg.data["map"]))
+            elif msg.type == "osdmap_incs":
+                await q.put(("incs", msg.data.get("incs", [])))
 
         self.msgr.add_dispatcher(d)
         try:
+            # delta catch-up: the mon answers with the incremental
+            # chain while it still holds it, the full map otherwise
             await self.msgr.send(self.mon_addr, "mon.0",
-                                 Message("sub_osdmap", {}))
-            new_map = OSDMap.from_dict(
-                await asyncio.wait_for(q.get(), timeout))
+                                 Message("get_osdmap",
+                                         {"since": self.osdmap.epoch}))
+            kind, payload = await asyncio.wait_for(q.get(), timeout)
             self._refresh_at = asyncio.get_event_loop().time()
+            if kind == "incs":
+                for inc_d in payload:
+                    inc = Incremental.from_dict(inc_d)
+                    # _dispatch may have applied some while we waited
+                    if inc.epoch == self.osdmap.epoch + 1:
+                        self.osdmap.apply_incremental(inc)
+                return
+            new_map = OSDMap.from_dict(payload)
             # a slow full-map reply must not regress past incrementals
             # _dispatch applied while we waited
             if new_map.epoch >= self.osdmap.epoch:

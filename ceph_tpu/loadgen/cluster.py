@@ -45,7 +45,6 @@ class SimCluster:
         mon = Monitor(rank=0, config={"mon_osd_min_down_reporters": 1,
                                       **(mon_config or {})})
         addr = await mon.start()
-        mon.peer_addrs = [addr]
         cfg = dict(cls.scaled_osd_config(n_osds))
         cfg.update(osd_config or {})
         osds: list[OSD] = []
@@ -100,7 +99,7 @@ class SimCluster:
 
     @property
     def addr(self):
-        return self.mon.msgr.addr
+        return self.mon.addr
 
     async def stop(self) -> None:
         for o in self.osds:
@@ -111,9 +110,7 @@ class SimCluster:
     async def kill_osd(self, index: int) -> dict:
         """Stop an OSD, keeping what a revive needs."""
         osd = self.osds[index]
-        token = {"uuid": osd.uuid, "whoami": osd.whoami,
-                 "store": osd.store, "host": osd.host,
-                 "config": dict(osd._base_config)}
+        token = osd.revive_token()
         await osd.stop()
         return token
 
@@ -121,13 +118,13 @@ class SimCluster:
         osd = OSD(uuid=token["uuid"], whoami=token["whoami"],
                   store=token["store"], host=token["host"],
                   config=token["config"], fault_injector=self.faults)
-        await osd.start(self.mon.msgr.addr)
+        await osd.start(self.mon.addr)
         self.osds[index] = osd
 
     async def wait_down(self, osd_id: int, timeout: float = 30.0) -> bool:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if not self.mon.osdmap.is_up(osd_id):
+            if not self.mon.osd_is_up(osd_id):
                 return True
             await asyncio.sleep(0.2)
         return False
@@ -135,7 +132,7 @@ class SimCluster:
     async def wait_up(self, osd_id: int, timeout: float = 30.0) -> bool:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if self.mon.osdmap.is_up(osd_id):
+            if self.mon.osd_is_up(osd_id):
                 return True
             await asyncio.sleep(0.2)
         return False
@@ -144,17 +141,8 @@ class SimCluster:
         """Best-effort wait until no primary has pending recovery."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            busy = False
-            for osd in self.osds:
-                for pg in osd.pgs.values():
-                    if not pg.is_primary():
-                        continue
-                    if pg.state != "active" or pg._recovery_pending():
-                        busy = True
-                        break
-                if busy:
-                    break
-            if not busy:
+            if not any(osd.has_pending_recovery()
+                       for osd in self.osds):
                 return True
             await asyncio.sleep(0.2)
         return False
@@ -170,7 +158,7 @@ class SimCluster:
             # counting its frozen lifetime counters makes phase deltas
             # spanning the revive (which swaps in a fresh instance, at
             # zero) go negative
-            if osd._stopped:
+            if osd.is_stopped():
                 continue
             pc = osd.perf.get(which)
             if pc is None:
@@ -186,7 +174,7 @@ class SimCluster:
         across OSDs (a sum of instantaneous depths means nothing)."""
         out: dict[str, float] = {}
         for osd in self.osds:
-            if osd._stopped:
+            if osd.is_stopped():
                 continue
             pc = osd.perf.get("scheduler")
             if pc is None:
@@ -203,9 +191,8 @@ class SimCluster:
     def pg_states(self) -> dict[str, int]:
         states: dict[str, int] = {}
         for osd in self.osds:
-            if osd._stopped:
+            if osd.is_stopped():
                 continue
-            for pg in osd.pgs.values():
-                if pg.is_primary():
-                    states[pg.state] = states.get(pg.state, 0) + 1
+            for state, n in osd.primary_pg_states().items():
+                states[state] = states.get(state, 0) + n
         return states

@@ -180,6 +180,30 @@ class Monitor:
     def is_leader(self) -> bool:
         return self.leader == self.rank or self._n_mons() <= 1
 
+    # -- public accessors (the in-process daemon boundary) ------------------
+    # Harness/bench code must not hold the mon's live subsystems
+    # (cross-daemon-state rule): these return plain data that a
+    # mon_command round-trip could equally serve in the swarm.
+
+    @property
+    def addr(self) -> tuple[str, int] | None:
+        """The mon's bound messenger address (None before start)."""
+        return self.msgr.addr
+
+    def osd_is_up(self, osd_id: int) -> bool:
+        """Liveness of one OSD in the mon's current map view."""
+        return self.osdmap.is_up(osd_id)
+
+    def osd_addr(self, osd_id: int) -> tuple[str, int] | None:
+        """Bound address of one OSD per the mon's current map view."""
+        info = self.osdmap.osds.get(osd_id)
+        addr = getattr(info, "addr", None)
+        return tuple(addr) if addr else None
+
+    def placement_counters(self) -> dict:
+        """Snapshot of the mon-side placement-cache perf counters."""
+        return self.osdmap.placement_perf.dump()
+
     def _n_mons(self) -> int:
         return len([a for a in self.peer_addrs if a is not None])
 
@@ -878,6 +902,10 @@ class Monitor:
             rk = self.cephx.service_keys(d["service"])
             if rk.gen != gen_before:
                 await self._persist_rotating(d["service"])
+            # the reply must seal with the key the client just
+            # proved with; a rotation landing during the persist must
+            # not swap it mid-exchange (clients re-auth on failure)
+            # lint: disable=await-invalidates-snapshot -- proof-bound key
             blob = seal(bytes.fromhex(rec["key"]), rk.to_dict())
             await conn.send(Message("auth_rotating_reply",
                                     {"sealed": blob, **extra}))
@@ -964,6 +992,10 @@ class Monitor:
                                 {"fsmap": fsmap, "you": you}))
 
     async def _h_sub_fsmap(self, conn, msg) -> None:
+        # subscription reply for MDS clients that subscribe over the
+        # wire; the in-tree client polls `fs dump` via mon_command
+        # instead, so no dispatcher matches the type yet
+        # lint: disable=wire-safety -- no in-tree fsmap subscriber
         await conn.send(Message("fsmap",
                                 {"fsmap": self.services.fsmap}))
 
@@ -1066,6 +1098,10 @@ class Monitor:
                                      "addr": mgrm["active_addr"]}))
 
     async def _h_get_osdmap(self, conn, msg) -> None:
+        # a delta fetch keeps the caller on the broadcast feed: the
+        # refresh path must survive a mon restart that dropped the
+        # subscriber table
+        self.subscribers[msg.from_name] = conn
         since = msg.data.get("since", 0)
         incs = [self.incrementals[e].to_dict()
                 for e in range(since + 1, self.osdmap.epoch + 1)
@@ -1338,6 +1374,9 @@ class Monitor:
         inc = Incremental(epoch=0)
         inc.removed_pools.append(pid)
         await self.propose(inc)
+        # pid is the id the command resolved and removed; returning
+        # the captured value after the commit is the contract
+        # lint: disable=await-invalidates-snapshot -- captured return value
         return pid
 
     def _cmd_osd_tree(self):

@@ -455,14 +455,16 @@ class OSDMap:
             info.up = True
             info.addr = tuple(addr) if addr else None
         for osd in inc.new_down:
-            if osd in self.osds:
-                self.osds[osd].up = False
-                self.osds[osd].down_at_epoch = inc.epoch
+            info = self.osds.get(osd)
+            if info is not None:
+                info.up = False
+                info.down_at_epoch = inc.epoch
         for osd in inc.new_in:
             self.osds.setdefault(osd, OsdInfo()).in_cluster = True
         for osd in inc.new_out:
-            if osd in self.osds:
-                self.osds[osd].in_cluster = False
+            info = self.osds.get(osd)
+            if info is not None:
+                info.in_cluster = False
         for osd, w in inc.new_weights.items():
             self.osds.setdefault(osd, OsdInfo()).weight = w
         for osd, uuid in inc.new_uuids.items():

@@ -176,5 +176,8 @@ WIRE_CODECS = {
     "rep_op": (_enc_rep_op, _dec_rep_op),
     "rep_op_reply": (_enc_rep_op_reply, _dec_rep_op_reply),
     "osd_ping": (_enc_osd_ping, _dec_osd_ping),
+    # ping and its echo deliberately share one layout (MOSDPing
+    # carries both directions upstream)
+    # lint: disable=denc-symmetry -- shared ping layout
     "osd_ping_reply": (_enc_osd_ping, _dec_osd_ping),
 }

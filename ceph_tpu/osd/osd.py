@@ -300,6 +300,13 @@ class OSD:
             self._scrubbing.add(pgid)
             try:
                 await self.scrub_reserver.request(pgid, timeout=30)
+                # the slot wait suspended: the PG may have been
+                # replaced or re-targeted by an epoch change -- scrub
+                # the current object, not the pre-wait snapshot
+                pg = self.pgs.get(pgid)
+                if pg is None or not pg.is_primary():
+                    return {"err": f"pg {pgid} moved while waiting "
+                                   f"for a scrub slot"}
                 from .scrub import scrub_pg
                 res = await scrub_pg(pg,
                                      repair=bool(req.get("repair")))
@@ -382,6 +389,45 @@ class OSD:
         if self.msgr:
             await self.msgr.shutdown()
         self.store.umount()
+
+    # -- public accessors (the in-process daemon boundary) ------------------
+    # Harness/bench code must not reach into the OSD's private state
+    # (cross-daemon-state rule): these expose the few facts the
+    # kill/revive/wait helpers need as plain data.
+
+    def is_stopped(self) -> bool:
+        return self._stopped
+
+    def revive_token(self) -> dict:
+        """Everything a revive needs to rebuild this OSD in place.
+        The store object rides along because an in-process revive
+        re-mounts the same backend; a multiprocess revive would carry
+        its path instead."""
+        return {"uuid": self.uuid, "whoami": self.whoami,
+                "store": self.store, "host": self.host,
+                "config": dict(self._base_config)}
+
+    def inflight_ops(self) -> int:
+        """Client ops awaiting replies on this OSD right now."""
+        return len(self._waiters)
+
+    def has_pending_recovery(self) -> bool:
+        """True while any primary PG here is degraded or still owes
+        recovery work (the wait_clean predicate)."""
+        for pg in self.pgs.values():
+            if not pg.is_primary():
+                continue
+            if pg.state != "active" or pg._recovery_pending():
+                return True
+        return False
+
+    def primary_pg_states(self) -> dict[str, int]:
+        """State -> count over the PGs this OSD leads."""
+        states: dict[str, int] = {}
+        for pg in self.pgs.values():
+            if pg.is_primary():
+                states[pg.state] = states.get(pg.state, 0) + 1
+        return states
 
     async def _mon_request(self, mtype: str, data: dict,
                            reply_type: str, timeout: float = 10) -> dict:
@@ -862,6 +908,9 @@ class OSD:
                 # reports during a real failure are how one kill
                 # cascades into a cluster-wide peering storm (the
                 # degraded-phase collapse the bench caught).
+                # the lag credit must use the SAME interval the
+                # sleep ran with; a config change applies next tick
+                # lint: disable=await-invalidates-snapshot -- per-tick snapshot
                 late = time.monotonic() - t0 - interval
                 if late > 0.2:
                     for osd in self._hb_last:
@@ -1031,6 +1080,9 @@ class OSD:
             last = self._hb_last.get(osd)
             if last is None:
                 self._hb_last[osd] = now     # start the clock
+            # one sweep judges every peer against ONE grace;
+            # re-reading mid-sweep grades peers on different clocks
+            # lint: disable=await-invalidates-snapshot -- per-sweep snapshot
             elif now - last > grace:
                 # yield once so queued ping/reply handlers run, then
                 # re-check: distinguishes "peer silent" from "our loop
@@ -1327,6 +1379,11 @@ class OSD:
                 return
             await self.scrub_reserver.request(pgid, timeout=30)
             got_local = True
+            # the slot wait suspended: re-read the PG, an epoch
+            # change may have replaced or deposed it meanwhile
+            pg = self.pgs.get(pgid)
+            if pg is None or not pg.is_primary():
+                return
             peers = [o for o in pg.acting_peers() if self.osd_is_up(o)]
             for o in peers:
                 replies = await self.fanout_and_wait(
@@ -1336,6 +1393,10 @@ class OSD:
                     return          # replica busy; retried next tick
                 granted_remote.append(o)
             from .scrub import scrub_pg
+            # the replica handshakes suspended too
+            pg = self.pgs.get(pgid)
+            if pg is None or not pg.is_primary():
+                return
             res = await scrub_pg(pg, repair=bool(
                 self.config.get("osd_scrub_auto_repair", True)))
             self._scrub_stamps[pgid] = time.monotonic()

@@ -478,6 +478,10 @@ class PG:
                     self._set_state("wait_acting_change")
                     await self._await_acting_change()
                     self._set_state("peering")
+            # a new interval cancels this peering task outright; if
+            # the acting-change wait returned, the entries snapshot
+            # still belongs to the interval being peered
+            # lint: disable=await-invalidates-snapshot -- interval-scoped task
             divergent = self.log.merge(auth_entries, best_info, self.missing)
             self._log_dirty = True       # wholesale surgery: rewrite
             self._clean_divergent(divergent)
@@ -1605,8 +1609,12 @@ class PG:
                 if not await self._backfill_push(peer, oid):
                     raise asyncio.TimeoutError(
                         f"backfill push {oid} to osd.{peer} failed")
-            new_cursor = bound if bound is not None else (
-                max(list(work_l) + list(work_r) + [bi["cursor"]]))
+            # this task is the sole owner of its peer's
+            # backfill_info record; a new interval cancels the task
+            # before replacing the dict
+            # lint: disable=await-invalidates-snapshot -- sole-owner cursor
+            fallback = max(list(work_l) + list(work_r) + [bi["cursor"]])
+            new_cursor = bound if bound is not None else fallback
             # drain writes that were skipped (log_only) while this batch
             # was in flight: their objects sit inside the window the
             # scan snapshotted, so the diff above missed them.  Repeat
@@ -1846,6 +1854,9 @@ class PG:
             [(peer, "pg_push", data, segs)], collect=True, timeout=10)
         if not replies or replies[0].data.get("err"):
             return                      # peer not ready; retried later
+        # new peering rebuilds peer_missing wholesale; a pop on a
+        # superseded missing-set mutates an orphaned object
+        # lint: disable=await-invalidates-snapshot -- stale pop is harmless
         ms.items.pop(oid, None)
 
     async def on_push(self, msg) -> dict:

@@ -72,7 +72,7 @@ class ChaosCluster(SimCluster):
         self.client.add_dispatcher(d)
         try:
             await self.client.send(
-                self.mon.msgr.addr, "mon.0",
+                self.addr, "mon.0",
                 Message("mon_command", {"cmd": cmd, "args": args or {}}))
             data = await asyncio.wait_for(q.get(), 10)
         finally:
@@ -103,6 +103,10 @@ class ChaosCluster(SimCluster):
 
     # -- data plane ----------------------------------------------------------
     def _target_for(self, pool_name: str, oid: str):
+        # the raw-messenger chaos client reads the mon's live map as
+        # its map-subscription stand-in; a swarm port subscribes over
+        # the wire via sub_osdmap instead
+        # lint: disable=cross-daemon-state -- in-process map shortcut
         omap = self.mon.osdmap
         pool_id = omap.pool_names[pool_name]
         _, ps = omap.object_to_pg(pool_id, oid)
@@ -129,7 +133,7 @@ class ChaosCluster(SimCluster):
                 if primary is None:
                     await asyncio.sleep(0.25)
                     continue
-                addr = self.mon.osdmap.osds[primary].addr
+                addr = self.mon.osd_addr(primary)
                 meta, segs = pack_mutations(ops)
                 try:
                     await self.client.send(
@@ -437,7 +441,7 @@ async def chaos_main(args) -> int:
         # bump epochs, so zero bulk recomputes means the epoch-keyed
         # invalidation never fired and the drive read stale placement
         pc = c.perf_counters("placement_cache")
-        mon_pc = c.mon.osdmap.placement_perf.dump()
+        mon_pc = c.mon.placement_counters()
         log(f"placement_cache counters: osds={pc} mon={mon_pc}")
         if not mon_pc.get("bulk_recomputes") or not pc.get(
                 "bulk_recomputes"):

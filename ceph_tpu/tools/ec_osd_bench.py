@@ -48,7 +48,6 @@ async def run_osd_path_bench(*, n_osds: int = 3, k: int = 2, m: int = 1,
 
     mon = Monitor(rank=0, config={"mon_osd_min_down_reporters": 1})
     addr = await mon.start()
-    mon.peer_addrs = [addr]
     osds = []
     for i in range(n_osds):
         cfg = {

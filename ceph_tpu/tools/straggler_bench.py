@@ -126,7 +126,7 @@ async def _drive_variant(spec: WorkloadSpec, *, hedge: bool,
             1 for t in asyncio.all_tasks()
             if not t.done()
             and getattr(t.get_coro(), "__name__", "") == "_issue")
-        waiters = sum(len(o._waiters) for o in cluster.osds)
+        waiters = sum(o.inflight_ops() for o in cluster.osds)
         lat = phase.hists["read"].summary()
         return {
             "hedge": hedge,

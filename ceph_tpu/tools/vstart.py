@@ -62,7 +62,6 @@ async def run_mon(args) -> None:
                                    "mon.0.asok")
                       if (args.asok_dir or args.store_dir) else None))
     addr = await mon.start(port=args.mon_port)
-    mon.peer_addrs = [addr]
     await _serve_until_signal(f"mon.0 at {addr[0]}:{addr[1]}")
     await mon.stop()
 
@@ -98,7 +97,6 @@ async def run_cluster(args) -> None:
                       os.path.join(asok_dir, "mon.0.asok")
                       if asok_dir else None))
     addr = await mon.start(port=args.mon_port)
-    mon.peer_addrs = [addr]
     print(f"mon.0 at {addr[0]}:{addr[1]}", flush=True)
     osds = []
     for i in range(args.osds):

@@ -1,0 +1,16 @@
+"""BAD: driver code reaches across the daemon process seam.
+
+Three shapes, all harmless while every daemon shares one interpreter
+and all dangling once each daemon owns a process: reading another
+daemon's private attribute, grabbing a live subsystem object, and
+mutating a daemon's state from outside.
+"""
+
+
+async def drain(cluster):
+    mon = cluster.mon
+    epoch = mon.osdmap.epoch       # live subsystem grab
+    stopped = mon._stopped         # private state read
+    for osd in cluster.osds:
+        osd.whoami = -1            # cross-daemon write
+    return epoch, stopped

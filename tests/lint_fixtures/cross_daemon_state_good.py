@@ -1,0 +1,14 @@
+"""GOOD: the same driver logic through the daemons' public surface.
+
+Accessor methods keep the state inside the owning daemon; the caller
+holds plain return values, never live subsystem objects.
+"""
+
+
+async def drain(cluster):
+    epoch = cluster.mon.current_epoch()
+    up = cluster.mon.osd_is_up(0)
+    for osd in cluster.osds:
+        if osd.is_stopped():
+            continue
+    return epoch, up

@@ -3,6 +3,8 @@
 `PinnedMap.denc` writes (u32 epoch, u64 size); `dedenc` reads them
 transposed -- fixed-width reads misalign silently.  `TailMap`'s
 decoder stops early, leaving an encoded tail nothing consumes.
+`unpack_frame` reads a dict key its `pack_frame` never writes, and
+the codec table hands one type another type's enc/dec pair.
 """
 
 from ceph_tpu.common import denc  # noqa: F401
@@ -35,3 +37,25 @@ class TailMap:
         obj = cls()
         obj.epoch = dec.u32()
         return obj
+
+
+def pack_frame(entries):
+    return {"n": len(entries), "body": list(entries)}
+
+
+def unpack_frame(blob):
+    return blob["items"]           # pack_frame never writes "items"
+
+
+def _enc_lease(enc, d):
+    enc.f64(d["expires"])
+
+
+def _dec_lease(dec):
+    return {"expires": dec.f64()}
+
+
+WIRE_CODECS = {
+    "lease": (_enc_lease, _dec_lease),
+    "lease_renew": (_enc_lease, _dec_lease),   # borrowed layout
+}

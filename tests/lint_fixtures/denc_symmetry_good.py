@@ -35,3 +35,24 @@ def _dec_entry(dec):
     osd = dec.u32()
     payload = dec.blob()
     return osd, payload
+
+
+def pack_frame(entries):
+    return {"n": len(entries), "body": list(entries)}
+
+
+def unpack_frame(blob):
+    return blob["body"][:blob["n"]]
+
+
+def _enc_lease(enc, d):
+    enc.f64(d["expires"])
+
+
+def _dec_lease(dec):
+    return {"expires": dec.f64()}
+
+
+WIRE_CODECS = {
+    "lease": (_enc_lease, _dec_lease),
+}

@@ -1,0 +1,25 @@
+"""BAD: snapshots of shared mutable state used across a suspension.
+
+The await yields the event loop; any other task may replace or
+mutate the source before the stale local is consulted.
+"""
+
+import asyncio
+
+PEERS = {}
+
+
+async def grade(name):
+    info = PEERS[name]
+    await asyncio.sleep(0.1)
+    return info["last_seen"]       # PEERS[name] may have been replaced
+
+
+class Scrubber:
+    def __init__(self):
+        self.queue = {}
+
+    async def pop_one(self, pgid):
+        item = self.queue.get(pgid)
+        await asyncio.sleep(0)
+        return item.priority       # the queue entry may be gone

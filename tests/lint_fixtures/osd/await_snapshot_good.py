@@ -1,0 +1,24 @@
+"""GOOD: the snapshot is either consumed before the suspension or
+re-read after it -- no stale window survives the await."""
+
+import asyncio
+
+PEERS = {}
+
+
+async def grade(name):
+    info = PEERS[name]
+    await asyncio.sleep(0.1)
+    info = PEERS[name]             # re-read after the suspension
+    return info["last_seen"]
+
+
+class Scrubber:
+    def __init__(self):
+        self.queue = {}
+
+    async def pop_one(self, pgid):
+        item = self.queue.get(pgid)
+        prio = item.priority       # consumed before the await
+        await asyncio.sleep(0)
+        return prio

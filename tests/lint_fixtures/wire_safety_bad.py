@@ -1,0 +1,24 @@
+"""BAD: message payloads carrying process-local objects.
+
+A future and a lock only mean something inside the interpreter that
+created them; serializing either across a process transport ships a
+dead token.  The type is also never consumed by any dispatcher, so
+the sender's reply wait would hang.
+"""
+
+import asyncio
+
+
+class Message:
+    def __init__(self, type, data):
+        self.type = type
+        self.data = data
+
+
+async def advertise(msgr, addr):
+    done = asyncio.Future()
+    await msgr.send(addr, "osd.0", Message("claim", {
+        "guard": asyncio.Lock(),
+        "done": done,
+    }))
+    return done

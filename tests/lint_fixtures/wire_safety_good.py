@@ -1,0 +1,22 @@
+"""GOOD: payloads ship plain data and the dispatcher consumes the
+type -- the receiving process rebuilds whatever live objects it
+needs from the values on the wire."""
+
+
+class Message:
+    def __init__(self, type, data):
+        self.type = type
+        self.data = data
+
+
+async def advertise(msgr, addr):
+    await msgr.send(addr, "osd.0", Message("claim", {
+        "holder": "osd.0",
+        "since": 12.5,
+    }))
+
+
+async def dispatch(msg):
+    if msg.type == "claim":
+        return msg.data["holder"]
+    return None

@@ -221,3 +221,25 @@ def test_real_tree_graph_sanity():
     # encode_async submits through the batcher
     reach = g.reachable([enc])
     assert any("codec_batcher.py::CodecBatcher." in q for q in reach)
+
+
+# -- daemon-boundary reachability (cross-daemon-state helper) ----------------
+
+def test_reach_origin_daemons_charges_shared_helper(tmp_path):
+    """A boundary reach inside a shared helper is charged to every
+    daemon class whose code can run it -- plain-function callers
+    (tools, loadgen) contribute no daemon origin."""
+    from ceph_tpu.analysis.checkers.cross_daemon_state import (
+        reach_origin_daemons)
+    g = graph_of(tmp_path, {
+        "helpers.py": ("def peek(mon):\n"
+                       "    return mon._stopped\n"),
+        "osd/osd.py": ("from helpers import peek\n\n\n"
+                       "class OSD:\n"
+                       "    def check(self, mon):\n"
+                       "        return peek(mon)\n"),
+        "tools/drive.py": ("from helpers import peek\n\n\n"
+                           "def drive(mon):\n"
+                           "    return peek(mon)\n"),
+    })
+    assert reach_origin_daemons(g, "helpers.py::peek") == {"OSD"}

@@ -53,6 +53,12 @@ RULE_FIXTURES = {
                          "counter_coverage_good.py"),
     "hot-path-config-read": ("hot_config_bad.py",
                              "hot_config_good.py"),
+    "cross-daemon-state": ("cross_daemon_state_bad.py",
+                           "cross_daemon_state_good.py"),
+    "wire-safety": ("wire_safety_bad.py",
+                    "wire_safety_good.py"),
+    "await-invalidates-snapshot": ("osd/await_snapshot_bad.py",
+                                   "osd/await_snapshot_good.py"),
 }
 
 
@@ -255,6 +261,46 @@ def test_baseline_roundtrip(tmp_path):
     assert kept3 == [] and n_base3 == 1
 
 
+def test_inline_suppression_project_rule(tmp_path):
+    """The suppression layers absorb interprocedural findings the
+    same way they absorb per-module ones."""
+    _write(tmp_path, "driver.py",
+           "def probe(mon):\n"
+           "    # lint: disable=cross-daemon-state -- test shortcut\n"
+           "    return mon._stopped\n")
+    kept, n_inline, _ = lint(["driver.py"], str(tmp_path))
+    assert kept == [] and n_inline == 1
+
+
+def test_baseline_roundtrip_project_rule(tmp_path):
+    _write(tmp_path, "driver.py",
+           "def probe(mon):\n    return mon._stopped\n")
+    kept, _, _ = lint(["driver.py"], str(tmp_path))
+    assert len(kept) == 1
+    assert kept[0].rule == "cross-daemon-state"
+    bl_path = str(tmp_path / "baseline.txt")
+    analysis.write_baseline(bl_path, kept)
+    baseline = analysis.load_baseline(bl_path)
+    kept2, _, n_base = lint(["driver.py"], str(tmp_path),
+                            baseline=baseline)
+    assert kept2 == [] and n_base == 1
+
+
+def test_await_snapshot_suppression_roundtrip(tmp_path):
+    """await-invalidates-snapshot honors the standalone-line-above
+    directive (how every in-tree justification is written)."""
+    (tmp_path / "osd").mkdir()
+    _write(tmp_path, "osd/loop.py",
+           "import asyncio\n\nSTATE = {}\n\n\n"
+           "async def tick(k):\n"
+           "    v = STATE[k]\n"
+           "    await asyncio.sleep(0)\n"
+           "    # lint: disable=await-invalidates-snapshot -- why\n"
+           "    return v\n")
+    kept, n_inline, _ = lint(["osd/loop.py"], str(tmp_path))
+    assert kept == [] and n_inline == 1
+
+
 def test_syntax_error_is_a_parse_finding(tmp_path):
     _write(tmp_path, "mod.py", "def broken(:\n")
     kept, _, _ = lint(["mod.py"], str(tmp_path))
@@ -314,3 +360,82 @@ def test_cli_profile_reports_per_rule_times():
     assert "[callgraph]" in res.stderr
     assert "[total]" in res.stderr
     assert "device-path-host-sync" in res.stderr
+    for rule in ("cross-daemon-state", "wire-safety",
+                 "await-invalidates-snapshot"):
+        assert rule in res.stderr
+
+
+def test_cli_format_json():
+    import json
+    bad = os.path.join("tests", "lint_fixtures", "x64_scope_bad.py")
+    res = _cli("--rules", "x64-scope", "--format", "json", bad)
+    assert res.returncode == 1
+    data = json.loads(res.stdout)
+    assert data and data[0]["rule"] == "x64-scope"
+    assert {"path", "line", "rule", "message"} <= set(data[0])
+    # a clean run emits an empty (but valid) document
+    res2 = _cli("--format", "json", "ceph_tpu/common")
+    assert res2.returncode == 0
+    assert json.loads(res2.stdout) == []
+
+
+def test_cli_format_sarif():
+    import json
+    bad = os.path.join("tests", "lint_fixtures", "x64_scope_bad.py")
+    res = _cli("--rules", "x64-scope", "--format", "sarif", bad)
+    assert res.returncode == 1
+    doc = json.loads(res.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert {"id": "x64-scope"} in run["tool"]["driver"]["rules"]
+    r = run["results"][0]
+    assert r["ruleId"] == "x64-scope"
+    loc = r["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith(
+        "x64_scope_bad.py")
+    assert loc["region"]["startLine"] >= 1
+
+
+# -- the process-seam audit --------------------------------------------------
+
+def test_seam_report_schema_and_nonemptiness():
+    """The swarm PR's entry gate: the audit must exist, follow the
+    schema, census real state, cover the wire vocabulary with
+    verdicts, and carry zero unjustified seam hazards."""
+    from ceph_tpu.analysis import seam_report
+    _, project = analysis.run(TREE_PATHS, REPO)
+    report = seam_report.build_report(project)
+    assert report["schema"] == "ceph-tpu-seam-audit-v1"
+    assert set(report) >= {"version", "shared_state",
+                           "daemon_reaches", "wire_types",
+                           "snapshot_races", "summary"}
+    s = report["summary"]
+    assert s["shared_state_sites"] >= 10
+    assert s["wire_types"] >= 30
+    assert s["unsafe_wire_types"] == []
+    assert s["unhandled_wire_types"] == []
+    assert s["unjustified_daemon_reaches"] == 0
+    assert s["unjustified_snapshot_races"] == 0
+    classes = {"fork-safe-cache", "per-process-counter",
+               "per-process-primitive", "correctness-state"}
+    for e in report["shared_state"]:
+        assert e["classification"] in classes
+        assert "analysis/" not in e["path"]
+    for e in report["wire_types"]:
+        assert e["verdict"] in ("wire-safe", "unsafe")
+        assert e["codec"] in ("typed", "generic", "control",
+                              "dynamic")
+    # a justified entry must carry its why text
+    for r in report["snapshot_races"] + report["daemon_reaches"]:
+        assert r["justified"] and r["justification"]
+
+
+def test_cli_seam_report(tmp_path):
+    import json
+    out = str(tmp_path / "audit.json")
+    res = _cli("--seam-report", out)
+    assert res.returncode == 0, res.stdout + res.stderr
+    with open(out) as fh:
+        doc = json.load(fh)
+    assert doc["schema"] == "ceph-tpu-seam-audit-v1"
+    assert doc["summary"]["shared_state_sites"] >= 10

@@ -9,6 +9,9 @@
     python tools/lint.py --list-rules
     python tools/lint.py --rules hole-sentinel,x64-scope ceph_tpu
     python tools/lint.py --write-baseline     # accept current findings
+    python tools/lint.py --format json        # findings as JSON
+    python tools/lint.py --format sarif       # findings as SARIF 2.1.0
+    python tools/lint.py --seam-report        # write SEAM_AUDIT.json
 
 Findings print as ``path:line rule message``; exit status is non-zero
 when any unsuppressed, unbaselined finding remains.  Suppress a single
@@ -27,6 +30,8 @@ whole-program findings in callers that did not change.
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import os
 import subprocess
 import sys
@@ -40,6 +45,34 @@ from ceph_tpu import analysis                            # noqa: E402
 DEFAULT_PATHS = ["ceph_tpu", "tools", "bench.py"]
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools",
                                 "lint_baseline.txt")
+DEFAULT_SEAM_REPORT = os.path.join(REPO_ROOT, "SEAM_AUDIT.json")
+
+
+def to_sarif(findings) -> dict:
+    """Minimal SARIF 2.1.0 document (one run, one result per
+    finding) -- enough for code-scanning upload and IDE ingestion."""
+    rules = sorted({f.rule for f in findings})
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "ceph-tpu-lint",
+                "informationUri":
+                    "https://example.invalid/ceph_tpu/analysis",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "warning",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line},
+                }}],
+            } for f in findings],
+        }],
+    }
 
 
 def _in_default_scope(path: str) -> bool:
@@ -92,6 +125,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline with the current "
                          "unsuppressed findings and exit 0")
+    ap.add_argument("--format", choices=["text", "json", "sarif"],
+                    default="text",
+                    help="findings output format (default: text)")
+    ap.add_argument("--seam-report", nargs="?", const="",
+                    default=None, metavar="PATH",
+                    help="write the process-seam audit (shared-state "
+                         "census, wire vocabulary, snapshot races) "
+                         "as JSON to PATH (default: SEAM_AUDIT.json) "
+                         "and exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -111,6 +153,9 @@ def main(argv: list[str] | None = None) -> int:
         paths = DEFAULT_PATHS
     else:
         paths = args.paths or DEFAULT_PATHS
+    if args.seam_report is not None:
+        # the audit is whole-program by definition
+        paths = DEFAULT_PATHS
 
     profile: dict[str, float] | None = ({} if args.profile else None)
     try:
@@ -119,6 +164,25 @@ def main(argv: list[str] | None = None) -> int:
     except KeyError as e:                   # unknown --rules entry
         print(f"lint: {e.args[0]}", file=sys.stderr)
         return 2
+
+    if args.seam_report is not None:
+        from ceph_tpu.analysis import seam_report
+        report = seam_report.build_report(project)
+        out_path = args.seam_report or DEFAULT_SEAM_REPORT
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+        s = report["summary"]
+        print(f"lint: seam audit -> "
+              f"{os.path.relpath(out_path, REPO_ROOT)}: "
+              f"{s['shared_state_sites']} shared-state site(s), "
+              f"{s['wire_types']} wire type(s), "
+              f"{s['daemon_reaches']} daemon reach(es) "
+              f"({s['unjustified_daemon_reaches']} unjustified), "
+              f"{s['snapshot_races']} snapshot race(s) "
+              f"({s['unjustified_snapshot_races']} unjustified)",
+              file=sys.stderr)
+        return 0
 
     if args.changed:
         closure = analysis.changed_closure(project, dirty)
@@ -150,8 +214,14 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 0
 
-    for f in kept:
-        print(f.render())
+    if args.format == "json":
+        print(json.dumps([dataclasses.asdict(f) for f in kept],
+                         indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(kept), indent=2))
+    else:
+        for f in kept:
+            print(f.render())
     nfiles = len(project.modules)
     extras = []
     if n_inline:
